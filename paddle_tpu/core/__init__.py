@@ -1,0 +1,11 @@
+"""Core abstractions: Place, dtype policy, DDim, sequence batches.
+
+TPU-native equivalent of paddle/platform (Place/DeviceContext), the dtype/dim
+machinery of paddle/framework (ddim.h), and the sequence metadata of
+paddle/parameter/Argument.h.
+"""
+
+from paddle_tpu.core.place import Place, CPUPlace, TPUPlace, default_place, set_default_place
+from paddle_tpu.core.ddim import DDim, make_ddim
+from paddle_tpu.core import dtype
+from paddle_tpu.core.sequence import SequenceBatch, NestedSequenceBatch

@@ -1,0 +1,53 @@
+"""Dtype policy.
+
+The reference is compiled for one `real` type (float or double, cf.
+WITH_DOUBLE, CMakeLists.txt:44). Here dtype is a runtime policy: float32 is
+the default numeric type for parity with gradient-check tolerances; bfloat16
+is the TPU performance type for matmul-heavy benchmarks (MXU-native).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.utils import flags
+
+float32 = jnp.float32
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+int32 = jnp.int32
+int64 = jnp.int64
+bool_ = jnp.bool_
+
+_NAMES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float64": jnp.float64,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+}
+
+
+def canonical(dtype):
+    if dtype is None:
+        return default_dtype()
+    if isinstance(dtype, str):
+        return _NAMES[dtype]
+    return jnp.dtype(dtype).type
+
+
+def default_dtype():
+    return _NAMES[flags.get_flag("default_dtype")]
+
+
+def set_default_dtype(dtype):
+    name = np.dtype(dtype).name if not isinstance(dtype, str) else dtype
+    flags.set_flag("default_dtype", name)
+
+
+def matmul_precision():
+    """jax.lax precision for MXU matmuls; 'highest' keeps fp32 accumulation so
+    numeric-vs-analytic gradient checks pass with reference tolerances
+    (cf. SURVEY.md hard-parts: fp32-on-TPU toggle)."""
+    return flags.get_flag("matmul_precision")

@@ -1,0 +1,92 @@
+"""Device places.
+
+Equivalent of paddle/platform/place.h:23-59 (CPUPlace/GPUPlace variant) and
+DeviceContext (device_context.h:31-56). On TPU there are no user-managed
+streams — XLA owns scheduling — so a Place resolves to a `jax.Device` and a
+`jax.sharding.SingleDeviceSharding`; DeviceContext's stream/event role is
+subsumed by jax dispatch + ``block_until_ready``.
+"""
+
+import threading
+
+from paddle_tpu.utils.error import enforce
+
+
+class Place:
+    """Abstract device place; value-semantic and hashable (cf. platform::Place)."""
+
+    device_id = 0
+
+    def jax_device(self):
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+
+class CPUPlace(Place):
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def jax_device(self):
+        import jax
+
+        cpus = jax.devices("cpu")
+        enforce(self.device_id < len(cpus), "CPUPlace(%d) out of range", self.device_id)
+        return cpus[self.device_id]
+
+
+class TPUPlace(Place):
+    """An accelerator place (cf. platform::GPUPlace, place.h:33)."""
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def jax_device(self):
+        import jax
+
+        accel = [d for d in jax.devices() if d.platform != "cpu"]
+        if not accel:  # CPU-only build parity: the cuda stub backend
+            accel = jax.devices()  # (reference: paddle/cuda/include/stub)
+        enforce(self.device_id < len(accel), "TPUPlace(%d) out of range", self.device_id)
+        return accel[self.device_id]
+
+
+_state = threading.local()
+_default = [None]
+
+
+def default_place():
+    if _default[0] is None:
+        import jax
+
+        has_accel = any(d.platform != "cpu" for d in jax.devices())
+        _default[0] = TPUPlace() if has_accel else CPUPlace()
+    return _default[0]
+
+
+def set_default_place(place):
+    enforce(isinstance(place, Place), "expected a Place, got %r", place)
+    _default[0] = place
+
+
+def device_count(place_type=None):
+    import jax
+
+    if place_type is CPUPlace:
+        return len(jax.devices("cpu"))
+    return len([d for d in jax.devices() if d.platform != "cpu"]) or len(jax.devices())
+
+
+def device_put(tree, place=None):
+    """Stage a pytree onto a place (cf. memcpy H2D, paddle/memory/memcpy.h)."""
+    import jax
+
+    place = place or default_place()
+    return jax.device_put(tree, place.jax_device())

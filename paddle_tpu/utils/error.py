@@ -1,0 +1,61 @@
+"""Error checking.
+
+Equivalent of PADDLE_ENFORCE (reference: paddle/platform/enforce.h) and
+paddle/utils/Error.h. Raises rich Python exceptions instead of aborting; the
+layer-stack annotation that CustomStackTrace provided (reference:
+paddle/utils/CustomStackTrace.h, used at gserver NeuralNetwork.cpp:244) is
+reproduced by :func:`layer_scope`, which tags exceptions with the network
+layer being traced when they escape.
+"""
+
+import contextlib
+import threading
+
+
+class EnforceError(AssertionError):
+    pass
+
+
+def enforce(condition, message="enforce failed", *args):
+    if not condition:
+        if args:
+            message = message % args
+        stack = _layer_stack.stack if getattr(_layer_stack, "stack", None) else None
+        if stack:
+            message = "%s (while building/tracing layer stack: %s)" % (
+                message,
+                " -> ".join(stack),
+            )
+        raise EnforceError(message)
+
+
+def enforce_eq(a, b, message=""):
+    enforce(a == b, "%s: %r != %r" % (message or "enforce_eq failed", a, b))
+
+
+_layer_stack = threading.local()
+
+
+@contextlib.contextmanager
+def layer_scope(name):
+    """Track the layer under construction/tracing so errors name the culprit."""
+    stack = getattr(_layer_stack, "stack", None)
+    if stack is None:
+        stack = _layer_stack.stack = []
+    stack.append(name)
+    try:
+        yield
+    except EnforceError:
+        raise
+    except Exception as exc:
+        exc.args = (
+            "%s (in layer %r; layer stack: %s)"
+            % (exc.args[0] if exc.args else "", name, " -> ".join(stack)),
+        ) + tuple(exc.args[1:])
+        raise
+    finally:
+        stack.pop()
+
+
+def current_layer_stack():
+    return list(getattr(_layer_stack, "stack", []) or [])

@@ -1,0 +1,90 @@
+"""Global flag registry.
+
+TPU-native equivalent of the gflags registry in paddle/utils/Flags.cpp:18-74
+(40+ process flags: use_gpu, trainer_count, port, log_period, ...). Flags are
+typed, defaulted, override-able from the environment (``PADDLE_TPU_<NAME>``),
+and readable anywhere. Unlike gflags there is no separate link-time
+registration step: modules call :func:`define_flag` at import time.
+"""
+
+import os
+import threading
+
+_lock = threading.RLock()
+_defs = {}  # name -> (type, default, help)
+_values = {}
+
+
+class FlagError(KeyError):
+    pass
+
+
+def _coerce(ftype, raw):
+    if ftype is bool and isinstance(raw, str):
+        return raw.lower() in ("1", "true", "yes", "on")
+    return ftype(raw)
+
+
+def define_flag(name, default, help_str=""):
+    """Register a flag. Environment variable PADDLE_TPU_<NAME> overrides the default."""
+    ftype = type(default)
+    with _lock:
+        if name in _defs:
+            return
+        _defs[name] = (ftype, default, help_str)
+        env = os.environ.get("PADDLE_TPU_" + name.upper())
+        _values[name] = _coerce(ftype, env) if env is not None else default
+
+
+def get_flag(name):
+    with _lock:
+        if name not in _values:
+            raise FlagError("undefined flag: %r" % name)
+        return _values[name]
+
+
+def set_flag(name, value, create=False):
+    with _lock:
+        if name not in _defs:
+            if not create:
+                raise FlagError("undefined flag: %r" % name)
+            _defs[name] = (type(value), value, "")
+            _values[name] = value
+            return
+        ftype = _defs[name][0]
+        _values[name] = _coerce(ftype, value)
+
+
+def all_flags():
+    with _lock:
+        return dict(_values)
+
+
+def reset_flag(name):
+    with _lock:
+        if name in _defs:
+            _values[name] = _defs[name][1]
+
+
+# Core process flags (parity set: paddle/utils/Flags.cpp:18-74).
+define_flag("use_tpu", True, "run compute on TPU devices (cf. --use_gpu)")
+define_flag("trainer_count", 1, "data-parallel width (cf. --trainer_count)")
+define_flag("trainer_id", 0, "distinct id per trainer process (cf. --trainer_id)")
+define_flag("seed", 0, "global RNG seed; 0 derives from time (cf. --seed)")
+define_flag("log_period", 100, "log train stats every N batches (cf. --log_period)")
+define_flag("test_period", 0, "run a test pass every N batches; 0 = per pass")
+define_flag("show_layer_stat", False, "log per-layer output stats every log_period")
+define_flag("show_parameter_stats_period", 0, "log per-parameter stats every N batches")
+define_flag("default_dtype", "float32", "parameter/activation dtype")
+define_flag("matmul_precision", "highest", "jax matmul precision: default|high|highest")
+define_flag("enable_x64", False, "enable float64/int64 (cf. WITH_DOUBLE)")
+define_flag("checkgrad_eps", 1e-4, "perturbation for numeric gradient checking")
+define_flag("prefetch_batches", 4, "data-provider background prefetch depth")
+define_flag("save_dir", "", "checkpoint output directory (cf. --save_dir)")
+define_flag("init_model_path", "", "load parameters from this path before training")
+define_flag("start_pass", 0, "resume pass number (cf. --start_pass)")
+define_flag("num_passes", 1, "number of training passes (cf. --num_passes)")
+define_flag("coordinator_endpoint", "", "host:port of the elastic coordinator service")
+define_flag("num_shards_per_task", 8, "dataset chunks per coordinator task")
+define_flag("task_timeout_sec", 600.0, "coordinator task timeout (cf. go/master timeoutDur)")
+define_flag("task_failure_max", 3, "drop a task after N failures (cf. go/master failureMax)")

@@ -1,0 +1,22 @@
+"""Logging (glog-wrapper parity, reference: paddle/utils/Logging.h)."""
+
+import logging
+import os
+import sys
+
+logger = logging.getLogger("paddle_tpu")
+
+if not logger.handlers:
+    _handler = logging.StreamHandler(sys.stderr)
+    _handler.setFormatter(
+        logging.Formatter("%(levelname).1s %(asctime)s %(name)s %(message)s", "%H:%M:%S")
+    )
+    logger.addHandler(_handler)
+    logger.setLevel(os.environ.get("PADDLE_TPU_LOG_LEVEL", "INFO").upper())
+    logger.propagate = False
+
+
+def set_level(level):
+    if isinstance(level, str):
+        level = level.upper()
+    logger.setLevel(level)

@@ -1,0 +1,45 @@
+"""Generic name->factory registry.
+
+Equivalent of ClassRegistrar (reference: paddle/utils/ClassRegistrar.h) which
+backs REGISTER_LAYER / REGISTER_EVALUATOR / activation registries in the
+reference. One generic class serves all of them here.
+"""
+
+from paddle_tpu.utils.error import enforce
+
+
+class Registry:
+    def __init__(self, kind):
+        self.kind = kind
+        self._entries = {}
+
+    def register(self, name, obj=None, aliases=()):
+        """Register ``obj`` under ``name``; usable as a decorator."""
+
+        def do_register(o):
+            enforce(name not in self._entries, "%s %r already registered", self.kind, name)
+            self._entries[name] = o
+            for alias in aliases:
+                enforce(
+                    alias not in self._entries, "%s %r already registered", self.kind, alias
+                )
+                self._entries[alias] = o
+            return o
+
+        if obj is None:
+            return do_register
+        return do_register(obj)
+
+    def get(self, name):
+        enforce(name in self._entries, "unknown %s: %r (have: %s)", self.kind, name,
+                ", ".join(sorted(self._entries)))
+        return self._entries[name]
+
+    def create(self, name, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+    def __contains__(self, name):
+        return name in self._entries
+
+    def names(self):
+        return sorted(self._entries)

@@ -1,0 +1,111 @@
+"""SequenceBatch / NestedSequenceBatch semantics tests.
+
+Parity targets: Argument.sequenceStartPositions round-tripping
+(paddle/parameter/Argument.h:84-90, tested by the reference's Argument and
+PyDataProvider2 tests) and sequence gather ops (hl_sequence.h)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.sequence import (
+    NestedSequenceBatch,
+    SequenceBatch,
+    bucket_length,
+)
+
+
+def _ragged(lengths, dim=3):
+    return [np.random.randn(l, dim).astype(np.float32) for l in lengths]
+
+
+def test_bucket_length():
+    assert bucket_length(1) == 16
+    assert bucket_length(17) == 32
+    assert bucket_length(5000) == 5000
+
+
+def test_from_sequences_and_mask():
+    seqs = _ragged([3, 5, 1])
+    sb = SequenceBatch.from_sequences(seqs)
+    assert sb.batch_size == 3
+    assert sb.max_len == 16  # bucketed
+    np.testing.assert_array_equal(np.asarray(sb.lengths), [3, 5, 1])
+    m = np.asarray(sb.mask())
+    assert m[0, :3].all() and not m[0, 3:].any()
+    assert m[1, :5].all() and not m[1, 5:].any()
+
+
+def test_flat_roundtrip():
+    seqs = _ragged([2, 4, 3])
+    flat = np.concatenate(seqs)
+    pos = [0, 2, 6, 9]
+    sb = SequenceBatch.from_flat(flat, pos)
+    flat2, pos2 = sb.to_flat()
+    np.testing.assert_allclose(flat, flat2, rtol=1e-6)
+    np.testing.assert_array_equal(pos, pos2)
+
+
+def test_last_first_step():
+    seqs = _ragged([2, 4])
+    sb = SequenceBatch.from_sequences(seqs)
+    np.testing.assert_allclose(np.asarray(sb.last_step())[0], seqs[0][1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sb.last_step())[1], seqs[1][3], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sb.first_step())[0], seqs[0][0], rtol=1e-6)
+
+
+def test_reverse():
+    seqs = _ragged([3, 2])
+    sb = SequenceBatch.from_sequences(seqs)
+    rv = sb.reverse()
+    np.testing.assert_allclose(np.asarray(rv.data)[0, :3], seqs[0][::-1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(rv.data)[1, :2], seqs[1][::-1], rtol=1e-6)
+    # double reverse is identity on the valid region
+    rv2 = rv.reverse()
+    np.testing.assert_allclose(
+        np.asarray(rv2.data)[0, :3], seqs[0], rtol=1e-6
+    )
+
+
+def test_segment_ids():
+    sb = SequenceBatch.from_sequences(_ragged([2, 3]), max_len=4)
+    ids = np.asarray(sb.segment_ids()).reshape(2, 4)
+    np.testing.assert_array_equal(ids[0], [0, 0, -1, -1])
+    np.testing.assert_array_equal(ids[1], [1, 1, 1, -1])
+
+
+def test_pytree_through_jit():
+    sb = SequenceBatch.from_sequences(_ragged([2, 3]))
+
+    @jax.jit
+    def f(s):
+        return s.map_data(lambda d: d * 2.0)
+
+    out = f(sb)
+    np.testing.assert_allclose(
+        np.asarray(out.data), np.asarray(sb.data) * 2, rtol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(out.lengths), np.asarray(sb.lengths))
+
+
+def test_nested():
+    nested = [
+        [np.ones((2, 4), np.float32), np.ones((3, 4), np.float32) * 2],
+        [np.ones((1, 4), np.float32) * 3],
+    ]
+    nb = NestedSequenceBatch.from_nested(nested)
+    assert nb.batch_size == 2 and nb.max_subseqs == 2
+    np.testing.assert_array_equal(np.asarray(nb.outer_lengths), [2, 1])
+    np.testing.assert_array_equal(np.asarray(nb.inner_lengths), [[2, 3], [1, 0]])
+    inner = nb.flatten_to_subsequences()
+    assert inner.batch_size == 4
+    np.testing.assert_array_equal(np.asarray(inner.lengths), [2, 3, 1, 0])
+    om = np.asarray(nb.outer_mask())
+    np.testing.assert_array_equal(om, [[True, True], [True, False]])
+    im = np.asarray(nb.inner_mask())
+    assert im[0, 0, :2].all() and not im[0, 0, 2:].any()
+    assert not im[1, 1].any()  # padded subsequence fully masked
+    # outer wrap of per-subsequence features
+    feats = jnp.arange(4.0).reshape(4, 1)
+    outer = nb.outer_sequence_of(feats)
+    assert outer.data.shape == (2, 2, 1)

@@ -1,0 +1,95 @@
+"""Unit tests for paddle_tpu.utils (flags/stat/error/registry) and core
+(place/ddim) — mirrors the granularity of paddle/utils tests and
+paddle/platform/*_test.cc in the reference."""
+
+import pytest
+
+from paddle_tpu.utils import flags
+from paddle_tpu.utils.error import EnforceError, enforce, layer_scope
+from paddle_tpu.utils.registry import Registry
+from paddle_tpu.utils.stat import StatSet
+from paddle_tpu.core.ddim import DDim, make_ddim, flatten_to_2d
+from paddle_tpu.core.place import CPUPlace, TPUPlace, default_place
+
+
+def test_flags_define_get_set():
+    flags.define_flag("test_only_flag", 42, "a test flag")
+    assert flags.get_flag("test_only_flag") == 42
+    flags.set_flag("test_only_flag", 7)
+    assert flags.get_flag("test_only_flag") == 7
+    flags.reset_flag("test_only_flag")
+    assert flags.get_flag("test_only_flag") == 42
+    with pytest.raises(flags.FlagError):
+        flags.get_flag("no_such_flag")
+
+
+def test_flag_type_coercion():
+    flags.define_flag("test_bool_flag", True)
+    flags.set_flag("test_bool_flag", "false")
+    assert flags.get_flag("test_bool_flag") is False
+    flags.set_flag("test_bool_flag", "1")
+    assert flags.get_flag("test_bool_flag") is True
+
+
+def test_enforce():
+    enforce(True, "fine")
+    with pytest.raises(EnforceError, match="boom 3"):
+        enforce(False, "boom %d", 3)
+
+
+def test_layer_scope_annotates_errors():
+    with pytest.raises(EnforceError, match="fc1"):
+        with layer_scope("fc1"):
+            enforce(False, "shape mismatch")
+    with pytest.raises(ValueError, match="conv2"):
+        with layer_scope("net"):
+            with layer_scope("conv2"):
+                raise ValueError("bad kernel")
+
+
+def test_registry():
+    reg = Registry("widget")
+
+    @reg.register("a", aliases=("alpha",))
+    class A:
+        pass
+
+    assert reg.get("a") is A
+    assert reg.get("alpha") is A
+    assert "a" in reg
+    with pytest.raises(EnforceError):
+        reg.register("a", A)
+    with pytest.raises(EnforceError):
+        reg.get("missing")
+
+
+def test_statset():
+    stats = StatSet("test")
+    with stats.timer("op"):
+        pass
+    with stats.timer("op"):
+        pass
+    info = stats.get("op")
+    assert info.count == 2
+    assert info.total >= 0
+    d = stats.as_dict()
+    assert d["op"]["count"] == 2
+
+
+def test_ddim():
+    d = make_ddim(2, 3, 4)
+    assert d.rank == 3
+    assert d.product() == 24
+    assert d.slice(1, 3) == (3, 4)
+    assert d.with_dim(0, 5) == (5, 3, 4)
+    assert flatten_to_2d(d, 1) == (2, 12)
+    assert flatten_to_2d(d, 2) == (6, 4)
+    assert make_ddim([1, 2]) == DDim((1, 2))
+
+
+def test_places():
+    cpu = CPUPlace()
+    assert cpu.jax_device().platform == "cpu"
+    assert CPUPlace(0) == CPUPlace(0)
+    assert CPUPlace(0) != TPUPlace(0)
+    assert default_place() is not None
