@@ -1,0 +1,189 @@
+"""Activation functions.
+
+Parity inventory: paddle/gserver/activations/ActivationFunction.cpp:94-443 —
+sigmoid, softmax, sequence_softmax, relu, brelu, tanh, stanh, softrelu, abs,
+square, exponential, reciprocal, sqrt, log, identity. Each is a stateless
+object with ``.apply`` over jnp arrays (fused by XLA into the surrounding
+layer's program — no separate "activation backward" needed, jax.grad covers
+it).
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.utils.registry import Registry
+
+activation_registry = Registry("activation")
+
+
+class BaseActivation:
+    name = None
+
+    def apply(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.apply(x)
+
+    def __repr__(self):
+        return "%s()" % type(self).__name__
+
+
+def _register(cls):
+    activation_registry.register(cls.name, cls)
+    return cls
+
+
+@_register
+class Linear(BaseActivation):
+    name = "linear"
+
+    def apply(self, x):
+        return x
+
+
+Identity = Linear
+
+
+@_register
+class Sigmoid(BaseActivation):
+    name = "sigmoid"
+
+    def apply(self, x):
+        return 1.0 / (1.0 + jnp.exp(-x))
+
+
+@_register
+class Tanh(BaseActivation):
+    name = "tanh"
+
+    def apply(self, x):
+        return jnp.tanh(x)
+
+
+@_register
+class STanh(BaseActivation):
+    """Scaled tanh: 1.7159 * tanh(2/3 x) (ActivationFunction.cpp stanh)."""
+
+    name = "stanh"
+
+    def apply(self, x):
+        return 1.7159 * jnp.tanh((2.0 / 3.0) * x)
+
+
+@_register
+class Relu(BaseActivation):
+    name = "relu"
+
+    def apply(self, x):
+        return jnp.maximum(x, 0.0)
+
+
+@_register
+class BRelu(BaseActivation):
+    """Bounded relu: min(max(x, 0), 24) (ActivationFunction.cpp brelu)."""
+
+    name = "brelu"
+
+    def apply(self, x):
+        return jnp.clip(x, 0.0, 24.0)
+
+
+@_register
+class SoftRelu(BaseActivation):
+    """log(1 + e^x), input clipped to +-40 like the reference."""
+
+    name = "softrelu"
+
+    def apply(self, x):
+        return jnp.log(1.0 + jnp.exp(jnp.clip(x, -40.0, 40.0)))
+
+
+@_register
+class Softmax(BaseActivation):
+    name = "softmax"
+
+    def apply(self, x):
+        z = x - jnp.max(x, axis=-1, keepdims=True)
+        e = jnp.exp(z)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+@_register
+class SequenceSoftmax(BaseActivation):
+    """Softmax across the *time* axis of a sequence of scalars
+    (ActivationFunction.cpp sequence_softmax). Applied by sequence-aware
+    layers which pass (values [B, T], mask [B, T])."""
+
+    name = "sequence_softmax"
+
+    def apply(self, x, mask=None):
+        if mask is None:
+            z = x - jnp.max(x, axis=-1, keepdims=True)
+            e = jnp.exp(z)
+            return e / jnp.sum(e, axis=-1, keepdims=True)
+        neg = jnp.finfo(x.dtype).min
+        masked = jnp.where(mask, x, neg)
+        z = masked - jnp.max(masked, axis=-1, keepdims=True)
+        e = jnp.exp(z) * mask.astype(x.dtype)
+        return e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-12)
+
+
+@_register
+class Exp(BaseActivation):
+    name = "exponential"
+
+    def apply(self, x):
+        return jnp.exp(x)
+
+
+@_register
+class Log(BaseActivation):
+    name = "log"
+
+    def apply(self, x):
+        return jnp.log(x)
+
+
+@_register
+class Abs(BaseActivation):
+    name = "abs"
+
+    def apply(self, x):
+        return jnp.abs(x)
+
+
+@_register
+class Square(BaseActivation):
+    name = "square"
+
+    def apply(self, x):
+        return x * x
+
+
+@_register
+class Reciprocal(BaseActivation):
+    name = "reciprocal"
+
+    def apply(self, x):
+        return 1.0 / x
+
+
+@_register
+class Sqrt(BaseActivation):
+    name = "sqrt"
+
+    def apply(self, x):
+        return jnp.sqrt(x)
+
+
+def to_activation(act):
+    """Accept an activation object, a registered name, or None (linear)."""
+    if act is None:
+        return Linear()
+    if isinstance(act, BaseActivation):
+        return act
+    if isinstance(act, str):
+        return activation_registry.create(act)
+    if isinstance(act, type) and issubclass(act, BaseActivation):
+        return act()
+    raise TypeError("cannot convert %r to an activation" % (act,))

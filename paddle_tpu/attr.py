@@ -1,0 +1,73 @@
+"""Parameter and layer extra attributes.
+
+Parity with trainer_config_helpers/attrs.py (reference:
+python/paddle/trainer_config_helpers/attrs.py — ParameterAttribute,
+ExtraLayerAttribute) and ParameterConfig proto fields
+(proto/ParameterConfig.proto): per-parameter learning-rate multipliers,
+L1/L2 decay, init policy, static (frozen) parameters, sparse update.
+"""
+
+
+class ParamAttr:
+    """Per-parameter configuration; ``name`` enables parameter sharing
+    between layers (same semantics as the reference's ParamAttr name)."""
+
+    def __init__(
+        self,
+        name=None,
+        is_static=False,
+        initial_std=None,
+        initial_mean=0.0,
+        initializer=None,
+        l1_rate=None,
+        l2_rate=None,
+        learning_rate=1.0,
+        momentum=None,
+        gradient_clipping_threshold=None,
+        sparse_update=False,
+    ):
+        self.name = name
+        self.is_static = is_static
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.initializer = initializer
+        self.l1_rate = l1_rate
+        self.l2_rate = l2_rate
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+        self.sparse_update = sparse_update
+
+    @staticmethod
+    def to_attr(arg):
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, bool):
+            return ParamAttr(is_static=not arg)
+        raise TypeError("cannot convert %r to ParamAttr" % (arg,))
+
+
+ParameterAttribute = ParamAttr
+
+
+class ExtraAttr:
+    """Extra layer attributes (cf. ExtraLayerAttribute): dropout, error
+    clipping, device hint (a sharding hint here instead of a GPU id)."""
+
+    def __init__(self, drop_rate=None, error_clipping_threshold=None, device=None):
+        self.drop_rate = drop_rate
+        self.error_clipping_threshold = error_clipping_threshold
+        self.device = device
+
+    @staticmethod
+    def to_attr(arg):
+        if arg is None:
+            return ExtraAttr()
+        if isinstance(arg, ExtraAttr):
+            return arg
+        raise TypeError("cannot convert %r to ExtraAttr" % (arg,))
+
+
+ExtraLayerAttribute = ExtraAttr

@@ -1,0 +1,86 @@
+"""Input type declarations for data layers and feeders.
+
+Parity with the reference's slot system: PyDataProvider2 input_types
+(reference: python/paddle/trainer/PyDataProvider2.py — dense_vector,
+sparse_binary_vector, sparse_vector, integer_value, × sequence and
+sub-sequence variants; slot taxonomy mirrored in C++ at
+gserver/dataproviders/PyDataProvider2.cpp:53-64).
+"""
+
+SEQ_NONE = 0
+SEQ_SINGLE = 1
+SEQ_NESTED = 2
+
+DENSE = "dense"
+SPARSE_BINARY = "sparse_binary"
+SPARSE_FLOAT = "sparse_float"
+INDEX = "index"
+
+
+class InputType:
+    def __init__(self, dim, seq_type, value_type):
+        self.dim = dim
+        self.seq_type = seq_type
+        self.value_type = value_type
+
+    def __repr__(self):
+        return "InputType(dim=%d, seq=%d, type=%s)" % (
+            self.dim,
+            self.seq_type,
+            self.value_type,
+        )
+
+
+def dense_vector(dim, seq_type=SEQ_NONE):
+    return InputType(dim, seq_type, DENSE)
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, SEQ_SINGLE)
+
+
+def dense_vector_sub_sequence(dim):
+    return dense_vector(dim, SEQ_NESTED)
+
+
+def dense_array(dim, seq_type=SEQ_NONE):
+    return InputType(dim, seq_type, DENSE)
+
+
+def sparse_binary_vector(dim, seq_type=SEQ_NONE):
+    return InputType(dim, seq_type, SPARSE_BINARY)
+
+
+def sparse_binary_vector_sequence(dim):
+    return sparse_binary_vector(dim, SEQ_SINGLE)
+
+
+def sparse_binary_vector_sub_sequence(dim):
+    return sparse_binary_vector(dim, SEQ_NESTED)
+
+
+def sparse_vector(dim, seq_type=SEQ_NONE):
+    return InputType(dim, seq_type, SPARSE_FLOAT)
+
+
+def sparse_vector_sequence(dim):
+    return sparse_vector(dim, SEQ_SINGLE)
+
+
+def sparse_vector_sub_sequence(dim):
+    return sparse_vector(dim, SEQ_NESTED)
+
+
+def integer_value(value_range, seq_type=SEQ_NONE):
+    return InputType(value_range, seq_type, INDEX)
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, SEQ_SINGLE)
+
+
+def integer_value_sub_sequence(value_range):
+    return integer_value(value_range, SEQ_NESTED)
+
+
+integer_sequence = integer_value_sequence

@@ -1,0 +1,21 @@
+"""Datasets (parity surface: python/paddle/v2/dataset — mnist, cifar,
+imdb, imikolov, movielens, conll05, uci_housing, wmt14, flowers, voc2012,
+mq2007, sentiment + download cache in common.py).
+
+This build environment has zero egress, so the download machinery
+(dataset.common parity) looks in a local cache directory and otherwise
+raises; every dataset also provides a ``synthetic`` reader with the same
+schema so demos/benchmarks run hermetically.
+"""
+
+from paddle_tpu.dataset import common
+from paddle_tpu.dataset import mnist
+from paddle_tpu.dataset import cifar
+from paddle_tpu.dataset import uci_housing
+from paddle_tpu.dataset import imdb
+from paddle_tpu.dataset import imikolov
+from paddle_tpu.dataset import movielens
+from paddle_tpu.dataset import conll05
+from paddle_tpu.dataset import wmt14
+from paddle_tpu.dataset import mq2007
+from paddle_tpu.dataset import sentiment

@@ -1,0 +1,38 @@
+"""CIFAR-10/100 (parity: python/paddle/v2/dataset/cifar.py).
+Schema: (image: float32[3072] in [0,1], label int)."""
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+IMAGE_DIM = 3 * 32 * 32
+
+
+def _synthetic(n, num_classes, seed):
+    rng = common.synthetic_rng("cifar%d" % num_classes, seed)
+    prototypes = rng.rand(num_classes, IMAGE_DIM).astype(np.float32)
+
+    def reader():
+        local = np.random.RandomState(seed + 1)
+        for i in range(n):
+            label = i % num_classes
+            img = 0.6 * prototypes[label] + 0.4 * local.rand(IMAGE_DIM)
+            yield img.astype(np.float32), label
+
+    return reader
+
+
+def train10(synthetic_size=4096):
+    return _synthetic(synthetic_size, 10, seed=0)
+
+
+def test10(synthetic_size=512):
+    return _synthetic(synthetic_size, 10, seed=7)
+
+
+def train100(synthetic_size=4096):
+    return _synthetic(synthetic_size, 100, seed=0)
+
+
+def test100(synthetic_size=512):
+    return _synthetic(synthetic_size, 100, seed=7)

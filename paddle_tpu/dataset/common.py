@@ -1,0 +1,47 @@
+"""Dataset cache helpers (parity: python/paddle/v2/dataset/common.py).
+
+The reference downloads archives into ~/.cache/paddle/dataset with MD5
+verification. This environment has no egress: ``download`` only serves
+files already present in the cache and raises otherwise, and each dataset
+module falls back to a deterministic synthetic generator with the real
+schema (so training demos, tests and benches run hermetically).
+"""
+
+import hashlib
+import os
+
+import numpy as np
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def data_path(module_name, filename):
+    return os.path.join(DATA_HOME, module_name, filename)
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum=None):
+    """Offline 'download': returns the cached file path if it exists and
+    matches md5; raises otherwise (zero-egress environment)."""
+    filename = data_path(module_name, url.split("/")[-1])
+    if os.path.exists(filename):
+        if md5sum is None or md5file(filename) == md5sum:
+            return filename
+    raise IOError(
+        "dataset file %s not in local cache %s and this environment has no "
+        "network access; use the dataset's synthetic_* readers instead"
+        % (url, filename))
+
+
+def synthetic_rng(name, seed=0):
+    """Deterministic per-dataset RNG so synthetic data is stable across runs."""
+    mix = int(hashlib.md5(("%s-%d" % (name, seed)).encode()).hexdigest()[:8], 16)
+    return np.random.RandomState(mix)

@@ -1,0 +1,40 @@
+"""CoNLL-05 SRL sequence tagging (parity: python/paddle/v2/dataset/conll05.py).
+Schema: (word ids, predicate id, ctx ids..., mark ids, label id sequence) —
+simplified to (word id seq, label id seq) plus dict accessors; used by the
+sequence_tagging demo parity."""
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+WORD_DICT_SIZE = 5000
+LABEL_DICT_SIZE = 67
+PRED_DICT_SIZE = 300
+
+
+def get_dict():
+    word_dict = {"w%d" % i: i for i in range(WORD_DICT_SIZE)}
+    verb_dict = {"v%d" % i: i for i in range(PRED_DICT_SIZE)}
+    label_dict = {"l%d" % i: i for i in range(LABEL_DICT_SIZE)}
+    return word_dict, verb_dict, label_dict
+
+
+def _synthetic(n, seed, min_len=5, max_len=40):
+    def reader():
+        local = np.random.RandomState(seed)
+        for _ in range(n):
+            length = local.randint(min_len, max_len + 1)
+            words = local.randint(0, WORD_DICT_SIZE, size=length).astype(np.int32)
+            # labels depend deterministically on words -> learnable
+            labels = (words % LABEL_DICT_SIZE).astype(np.int32)
+            yield words, labels
+
+    return reader
+
+
+def test(synthetic_size=512):
+    return _synthetic(synthetic_size, seed=3)
+
+
+def train(synthetic_size=4096):
+    return _synthetic(synthetic_size, seed=0)

@@ -1,0 +1,40 @@
+"""IMDB sentiment (parity: python/paddle/v2/dataset/imdb.py).
+Schema: (word id sequence, label 0/1). Used by the RNN benchmark
+(reference: benchmark/paddle/rnn)."""
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+WORD_DICT_SIZE = 30000
+
+
+def word_dict(size=WORD_DICT_SIZE):
+    return {"w%d" % i: i for i in range(size)}
+
+
+def _synthetic(n, seed, dict_size, min_len=20, max_len=100):
+    """Sentiment-separable synthetic text: positive docs oversample one
+    vocabulary band, negative the other."""
+    def reader():
+        local = np.random.RandomState(seed)
+        for i in range(n):
+            label = i % 2
+            length = local.randint(min_len, max_len + 1)
+            if label:
+                ids = local.randint(0, dict_size // 2, size=length)
+            else:
+                ids = local.randint(dict_size // 2, dict_size, size=length)
+            yield ids.astype(np.int32), label
+
+    return reader
+
+
+def train(word_idx=None, synthetic_size=2048):
+    size = len(word_idx) if word_idx else WORD_DICT_SIZE
+    return _synthetic(synthetic_size, 0, size)
+
+
+def test(word_idx=None, synthetic_size=512):
+    size = len(word_idx) if word_idx else WORD_DICT_SIZE
+    return _synthetic(synthetic_size, 3, size)

@@ -1,0 +1,36 @@
+"""PTB-style LM n-grams (parity: python/paddle/v2/dataset/imikolov.py).
+Schema: n-gram tuple of word ids."""
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+WORD_DICT_SIZE = 2000
+
+
+def build_dict(min_word_freq=50):
+    return {"w%d" % i: i for i in range(WORD_DICT_SIZE)}
+
+
+def _synthetic(word_idx, n, num, seed):
+    size = len(word_idx)
+
+    def reader():
+        local = np.random.RandomState(seed)
+        for _ in range(num):
+            # markov-ish: next word biased near previous
+            first = local.randint(0, size)
+            gram = [first]
+            for _ in range(n - 1):
+                gram.append((gram[-1] + local.randint(0, 20)) % size)
+            yield tuple(gram)
+
+    return reader
+
+
+def train(word_idx, n, synthetic_size=4096):
+    return _synthetic(word_idx, n, synthetic_size, seed=0)
+
+
+def test(word_idx, n, synthetic_size=512):
+    return _synthetic(word_idx, n, synthetic_size, seed=9)

@@ -1,0 +1,57 @@
+"""MovieLens ratings (parity: python/paddle/v2/dataset/movielens.py).
+Schema: (user_id, gender, age, occupation, movie_id, category_ids, title_ids,
+rating)."""
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+NUM_USERS = 6040
+NUM_MOVIES = 3952
+NUM_CATEGORIES = 18
+TITLE_DICT_SIZE = 5000
+
+
+def max_user_id():
+    return NUM_USERS
+
+
+def max_movie_id():
+    return NUM_MOVIES
+
+
+def max_job_id():
+    return 20
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def _synthetic(n, seed):
+    def reader():
+        local = np.random.RandomState(seed)
+        for _ in range(n):
+            user = local.randint(1, NUM_USERS + 1)
+            movie = local.randint(1, NUM_MOVIES + 1)
+            gender = local.randint(0, 2)
+            age = local.randint(0, 7)
+            job = local.randint(0, 21)
+            cats = local.randint(0, NUM_CATEGORIES,
+                                 size=local.randint(1, 4)).astype(np.int32)
+            title = local.randint(0, TITLE_DICT_SIZE,
+                                  size=local.randint(2, 8)).astype(np.int32)
+            # rating correlates with (user+movie) parity for learnability
+            rating = float(1 + (user * 31 + movie * 17) % 5)
+            yield user, gender, age, job, movie, cats, title, np.array(
+                [rating], np.float32)
+
+    return reader
+
+
+def train(synthetic_size=4096):
+    return _synthetic(synthetic_size, seed=0)
+
+
+def test(synthetic_size=512):
+    return _synthetic(synthetic_size, seed=11)

@@ -1,0 +1,34 @@
+"""UCI housing regression (parity: python/paddle/v2/dataset/uci_housing.py).
+Schema: (features: float32[13] normalized, price: float32[1])."""
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+FEATURE_DIM = 13
+
+
+def _synthetic(n, seed):
+    rng = common.synthetic_rng("uci_housing", seed)
+    true_w = rng.randn(FEATURE_DIM).astype(np.float32)
+
+    def reader():
+        local = np.random.RandomState(seed + 1)
+        for _ in range(n):
+            x = local.randn(FEATURE_DIM).astype(np.float32)
+            y = float(x @ true_w + 0.1 * local.randn())
+            yield x, np.array([y], np.float32)
+
+    return reader
+
+
+def train(synthetic_size=404):
+    return _synthetic(synthetic_size, seed=0)
+
+
+def test(synthetic_size=102):
+    return _synthetic(synthetic_size, seed=5)

@@ -1,0 +1,35 @@
+"""WMT-14 FR-EN translation pairs (parity: python/paddle/v2/dataset/wmt14.py).
+Schema: (source ids, target ids with <s>, target ids with <e>)."""
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+SOURCE_DICT_SIZE = 30000
+TARGET_DICT_SIZE = 30000
+START = 0
+END = 1
+UNK = 2
+
+
+def _synthetic(n, seed, min_len=4, max_len=30):
+    def reader():
+        local = np.random.RandomState(seed)
+        for _ in range(n):
+            length = local.randint(min_len, max_len + 1)
+            src = local.randint(3, SOURCE_DICT_SIZE, size=length).astype(np.int32)
+            # target = reversed source band-mapped (deterministic, learnable)
+            tgt = ((src[::-1] * 7) % (TARGET_DICT_SIZE - 3) + 3).astype(np.int32)
+            trg_with_start = np.concatenate([[START], tgt]).astype(np.int32)
+            trg_with_end = np.concatenate([tgt, [END]]).astype(np.int32)
+            yield src, trg_with_start, trg_with_end
+
+    return reader
+
+
+def train(dict_size=SOURCE_DICT_SIZE, synthetic_size=2048):
+    return _synthetic(synthetic_size, seed=0)
+
+
+def test(dict_size=SOURCE_DICT_SIZE, synthetic_size=256):
+    return _synthetic(synthetic_size, seed=21)

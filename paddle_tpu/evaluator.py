@@ -1,0 +1,195 @@
+"""Evaluators: streaming metrics computed inside the jitted step.
+
+Parity inventory (reference: gserver/evaluators/Evaluator.cpp:172-1346 +
+ChunkEvaluator.cpp, CTCErrorEvaluator.cpp): classification_error, sum,
+column_sum, auc (rankauc), precision_recall, pnpair, chunk, ctc_error, and
+value printers. Design: an evaluator is a LayerNode whose forward returns a
+small dict of batch statistics (computed on device, fused into the train
+step); the host accumulates with ``merge`` and finalizes with ``result`` —
+the same start/eval/finish lifecycle as the reference's Evaluator base, but
+with only O(1)-sized stats crossing the device boundary per batch.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layer.base import data_of, is_seq, make_node
+from paddle_tpu.utils.error import enforce
+
+
+class EvalNode:
+    """Mixin marker: LayerNodes with .merge/.result are evaluators."""
+
+
+def _mk_eval(kind, forward, inputs, name, merge_fn, result_fn):
+    node = make_node("evaluator:" + kind, forward, inputs, name=name, size=1)
+    node.is_evaluator = True
+    node.merge = merge_fn
+    node.result = result_fn
+    return node
+
+
+def _acc_add(acc, stats):
+    if acc is None:
+        return {k: np.asarray(v, dtype=np.float64) for k, v in stats.items()}
+    return {k: acc[k] + np.asarray(v, dtype=np.float64) for k, v in stats.items()}
+
+
+def classification_error(input, label, weight=None, name=None, top_k=1):
+    """Fraction of wrongly classified samples (reference:
+    ClassificationErrorEvaluator; supports sequences via masking and
+    sample weights)."""
+    inputs = [input, label] + ([weight] if weight is not None else [])
+
+    def forward(params, values, ctx):
+        out, lab = values[0], values[1]
+        x, y = data_of(out), data_of(lab).astype(jnp.int32)
+        if top_k == 1:
+            pred_ok = jnp.argmax(x, axis=-1).astype(jnp.int32) == y
+        else:
+            _, top_idx = jax.lax.top_k(x, top_k)
+            pred_ok = jnp.any(top_idx == y[..., None], axis=-1)
+        wrong = (~pred_ok).astype(jnp.float32)
+        if is_seq(lab):
+            m = lab.mask(jnp.float32)
+            if weight is not None:
+                m = m * data_of(values[2]).reshape(m.shape)
+            return {"wrong": jnp.sum(wrong * m), "total": jnp.sum(m)}
+        if weight is not None:
+            w = data_of(values[2]).reshape(wrong.shape)
+            return {"wrong": jnp.sum(wrong * w), "total": jnp.sum(w)}
+        return {"wrong": jnp.sum(wrong), "total": jnp.asarray(wrong.size, jnp.float32)}
+
+    def result(acc):
+        if not acc or acc["total"] == 0:
+            return 0.0
+        return float(acc["wrong"] / acc["total"])
+
+    return _mk_eval("classification_error", forward, inputs, name, _acc_add, result)
+
+
+def sum_evaluator(input, weight=None, name=None):
+    """Sum of input values (reference: SumEvaluator)."""
+    inputs = [input] + ([weight] if weight is not None else [])
+
+    def forward(params, values, ctx):
+        x = data_of(values[0])
+        if weight is not None:
+            x = x * data_of(values[1]).reshape(x.shape[:1] + (1,) * (x.ndim - 1))
+        return {"sum": jnp.sum(x), "count": jnp.asarray(x.shape[0], jnp.float32)}
+
+    def result(acc):
+        return float(acc["sum"]) if acc else 0.0
+
+    return _mk_eval("sum", forward, inputs, name, _acc_add, result)
+
+
+def column_sum_evaluator(input, weight=None, name=None):
+    """Per-column mean stats (reference: ColumnSumEvaluator)."""
+    inputs = [input] + ([weight] if weight is not None else [])
+
+    def forward(params, values, ctx):
+        x = data_of(values[0])
+        x2 = x.reshape(-1, x.shape[-1])
+        return {"col_sum": jnp.sum(x2, axis=0),
+                "count": jnp.asarray(x2.shape[0], jnp.float32)}
+
+    def result(acc):
+        if not acc or acc["count"] == 0:
+            return None
+        return (acc["col_sum"] / acc["count"]).tolist()
+
+    return _mk_eval("column_sum", forward, inputs, name, _acc_add, result)
+
+
+def auc(input, label, weight=None, name=None, num_thresholds=1024):
+    """Streaming AUC via score histograms (reference: AucEvaluator — which
+    also buckets for the distributed case). input column 1 (or the single
+    column) is P(positive)."""
+    inputs = [input, label] + ([weight] if weight is not None else [])
+
+    def forward(params, values, ctx):
+        x, y = data_of(values[0]), data_of(values[1]).reshape(-1)
+        score = x[..., 1] if x.shape[-1] > 1 else x[..., 0]
+        score = score.reshape(-1)
+        w = (data_of(values[2]).reshape(-1)
+             if weight is not None else jnp.ones_like(score))
+        bins = jnp.clip((score * num_thresholds).astype(jnp.int32), 0,
+                        num_thresholds - 1)
+        pos = jnp.zeros((num_thresholds,), jnp.float32).at[bins].add(
+            w * (y > 0))
+        neg = jnp.zeros((num_thresholds,), jnp.float32).at[bins].add(
+            w * (y <= 0))
+        return {"pos_hist": pos, "neg_hist": neg}
+
+    def result(acc):
+        if not acc:
+            return 0.0
+        pos, neg = acc["pos_hist"], acc["neg_hist"]
+        # integrate ROC from the high-score end (trapezoid on bin boundaries)
+        tp = np.cumsum(pos[::-1])
+        fp = np.cumsum(neg[::-1])
+        tot_pos, tot_neg = tp[-1], fp[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        tpr = np.concatenate([[0.0], tp / tot_pos])
+        fpr = np.concatenate([[0.0], fp / tot_neg])
+        return float(np.trapezoid(tpr, fpr))
+
+    return _mk_eval("auc", forward, inputs, name, _acc_add, result)
+
+
+def precision_recall(input, label, weight=None, name=None, positive_label=None):
+    """Per-class precision/recall/F1, macro + micro (reference:
+    PrecisionRecallEvaluator)."""
+    inputs = [input, label] + ([weight] if weight is not None else [])
+    num_classes = input.size
+
+    def forward(params, values, ctx):
+        x, y = data_of(values[0]), data_of(values[1]).reshape(-1).astype(jnp.int32)
+        pred = jnp.argmax(x.reshape(-1, x.shape[-1]), axis=-1)
+        w = (data_of(values[2]).reshape(-1)
+             if weight is not None else jnp.ones(pred.shape, jnp.float32))
+        oh_pred = jax_one_hot(pred, num_classes) * w[:, None]
+        oh_true = jax_one_hot(y, num_classes) * w[:, None]
+        tp = jnp.sum(oh_pred * oh_true, axis=0)
+        return {
+            "tp": tp,
+            "pred_count": jnp.sum(oh_pred, axis=0),
+            "true_count": jnp.sum(oh_true, axis=0),
+        }
+
+    def result(acc):
+        if not acc:
+            return {}
+        tp, pc, tc = acc["tp"], acc["pred_count"], acc["true_count"]
+        if positive_label is not None:
+            tp, pc, tc = (a[positive_label] for a in (tp, pc, tc))
+        prec = np.where(pc > 0, tp / np.maximum(pc, 1), 0.0)
+        rec = np.where(tc > 0, tp / np.maximum(tc, 1), 0.0)
+        f1 = np.where(prec + rec > 0, 2 * prec * rec / np.maximum(prec + rec, 1e-12), 0.0)
+        micro_p = tp.sum() / max(pc.sum(), 1.0) if np.ndim(tp) else prec
+        micro_r = tp.sum() / max(tc.sum(), 1.0) if np.ndim(tp) else rec
+        return {
+            "precision": prec.tolist() if np.ndim(prec) else float(prec),
+            "recall": rec.tolist() if np.ndim(rec) else float(rec),
+            "f1": f1.tolist() if np.ndim(f1) else float(f1),
+            "macro_f1": float(np.mean(f1)) if np.ndim(f1) else float(f1),
+            "micro_precision": float(micro_p) if np.ndim(tp) else float(prec),
+            "micro_recall": float(micro_r) if np.ndim(tp) else float(rec),
+        }
+
+    return _mk_eval("precision_recall", forward, inputs, name, _acc_add, result)
+
+
+def value_printer(input, name=None):
+    """Print layer values each eval (reference: ValuePrinter gadget)."""
+    from paddle_tpu.layer.sequence import print_layer
+
+    return print_layer(input, name=name)
+
+
+def jax_one_hot(idx, n):
+    return (idx[:, None] == jnp.arange(n)[None, :]).astype(jnp.float32)

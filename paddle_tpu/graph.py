@@ -1,0 +1,170 @@
+"""Lazy layer graph traced into one pure, jit-compiled XLA function.
+
+This replaces the reference's whole config->engine pipeline: the Python DSL
+built a ModelConfig proto (reference: python/paddle/trainer/config_parser.py,
+parse_config :3616) which C++ `GradientMachine::create` turned into a vector
+of `Layer` objects executed one virtual call at a time
+(gserver/gradientmachines/NeuralNetwork.cpp:235-285, Layer.h:376-452), with a
+hand-written backward per layer. Here each `paddle_tpu.layer.*` call creates a
+:class:`LayerNode` — a named DAG node carrying parameter specs and a pure
+``forward(params, inputs, ctx)`` — and :class:`paddle_tpu.topology.Topology`
+evaluates the DAG inside ``jax.jit``, so XLA fuses the entire
+forward+backward+update into a single TPU program and jax.grad supplies every
+backward (GradOpBuilder parity, reference: paddle/framework/grad_op_builder.cc).
+
+Values flowing along edges are jnp arrays, SequenceBatch, or
+NestedSequenceBatch. Parameters are keyed by *parameter name* (not layer
+name) so ParamAttr(name=...) shares weights between layers exactly like the
+reference.
+"""
+
+import itertools
+import threading
+
+import jax
+
+from paddle_tpu.attr import ExtraAttr, ParamAttr
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.utils.error import enforce, layer_scope
+
+_name_lock = threading.Lock()
+_name_counters = {}
+
+
+def auto_name(layer_type):
+    with _name_lock:
+        idx = _name_counters.get(layer_type, 0)
+        _name_counters[layer_type] = idx + 1
+    return "__%s_%d__" % (layer_type, idx)
+
+
+def reset_name_counters():
+    with _name_lock:
+        _name_counters.clear()
+
+
+class ParamSpec:
+    """Declaration of one named parameter buffer (cf. ParameterConfig proto +
+    Parameter, reference: paddle/parameter/Parameter.h:46)."""
+
+    __slots__ = ("name", "shape", "initializer", "attr", "dtype", "is_state")
+
+    def __init__(self, name, shape, initializer, attr=None, dtype=None, is_state=False):
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        self.initializer = initializer
+        self.attr = attr or ParamAttr()
+        self.dtype = dtype
+        self.is_state = is_state  # non-trainable running state (e.g. BN stats)
+
+    def materialize(self, rng, default_dtype):
+        dtype = self.dtype or default_dtype
+        return self.initializer(rng, self.shape, dtype)
+
+    def __repr__(self):
+        return "ParamSpec(%s, shape=%s%s)" % (
+            self.name,
+            self.shape,
+            ", state" if self.is_state else "",
+        )
+
+
+class Context:
+    """Per-trace evaluation context: train/test mode, RNG stream for
+    stochastic layers, and a sink for running-state updates (BN moving
+    stats) and auxiliary observations."""
+
+    def __init__(self, mode="train", rng=None):
+        self.mode = mode
+        self.rng = rng
+        self._rng_counter = itertools.count()
+        self.state_updates = {}
+        self.aux = {}
+
+    @property
+    def is_train(self):
+        return self.mode == "train"
+
+    def next_rng(self):
+        enforce(
+            self.rng is not None,
+            "this network uses stochastic layers (dropout/sampling); pass rng=",
+        )
+        return jax.random.fold_in(self.rng, next(self._rng_counter))
+
+    def update_state(self, name, value):
+        self.state_updates[name] = value
+
+    def observe(self, name, value):
+        self.aux[name] = value
+
+
+class LayerNode:
+    """One node of the layer DAG. ``forward_fn(params, inputs, ctx)`` is pure
+    in (params, inputs) given a ctx; ``size`` is the feature width exposed to
+    downstream layers (cf. LayerConfig.size, proto/ModelConfig.proto:314)."""
+
+    def __init__(
+        self,
+        layer_type,
+        forward_fn,
+        inputs=(),
+        name=None,
+        size=0,
+        param_specs=(),
+        extra_attr=None,
+        seq_level=None,
+    ):
+        self.layer_type = layer_type
+        self.name = name or auto_name(layer_type)
+        self.inputs = list(inputs)
+        self.size = size
+        self.param_specs = list(param_specs)
+        self.extra_attr = extra_attr or ExtraAttr()
+        self.seq_level = seq_level  # None=unknown, 0=plain, 1=seq, 2=nested
+        self._forward_fn = forward_fn
+
+    def forward(self, params, input_values, ctx):
+        with layer_scope(self.name):
+            out = self._forward_fn(params, input_values, ctx)
+        return out
+
+    # graph sugar: `layer + layer` builds addto, `layer * const` a scale node.
+    def __add__(self, other):
+        from paddle_tpu import layer as L
+
+        return L.addto(input=[self, other])
+
+    def __mul__(self, scalar):
+        from paddle_tpu import layer as L
+
+        return L.slope_intercept(input=self, slope=float(scalar))
+
+    __rmul__ = __mul__
+
+    def __repr__(self):
+        return "LayerNode(%s:%s, size=%d)" % (self.name, self.layer_type, self.size)
+
+
+LayerOutput = LayerNode  # v2-API name parity (python/paddle/v2 LayerOutput)
+
+
+def topo_sort(outputs):
+    """Post-order topological sort of the DAG reachable from ``outputs``."""
+    order, seen = [], set()
+    on_path = set()
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        enforce(id(node) not in on_path, "cycle in layer graph at %r", node.name)
+        on_path.add(id(node))
+        for parent in node.inputs:
+            visit(parent)
+        on_path.discard(id(node))
+        seen.add(id(node))
+        order.append(node)
+
+    for out in outputs:
+        visit(out)
+    return order

@@ -1,0 +1,51 @@
+"""Inference helper (parity: python/paddle/v2/inference.py, infer :93)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
+from paddle_tpu.graph import LayerNode
+from paddle_tpu.topology import Topology, convert_feed
+from paddle_tpu.utils.error import enforce
+
+
+class Inference:
+    """Compiled forward pass over output layers (no backward). The C
+    inference API (capi parity) wraps this same object from C via
+    paddle_tpu/capi."""
+
+    def __init__(self, output_layer, parameters):
+        outputs = [output_layer] if isinstance(output_layer, LayerNode) else list(output_layer)
+        self.topology = Topology(outputs)
+        self.outputs = outputs
+        self.parameters = parameters
+        param_values = {k: jnp.asarray(parameters.get(k))
+                        for k in parameters.names()}
+        topo = self.topology
+        out_names = [o.name for o in outputs]
+
+        @jax.jit
+        def forward(params, feed):
+            values, _ = topo.apply(params, feed, mode="test")
+            return {n: values[n] for n in out_names}
+
+        self._forward = forward
+        self._params = param_values
+
+    def infer(self, input, feeding=None, field="value"):
+        feed = convert_feed(self.topology, input, feeding)
+        out = self._forward(self._params, feed)
+        results = []
+        for node in self.outputs:
+            val = out[node.name]
+            if isinstance(val, (SequenceBatch, NestedSequenceBatch)):
+                results.append(np.asarray(val.data))
+            else:
+                results.append(np.asarray(val))
+        return results[0] if len(results) == 1 else results
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    return Inference(output_layer, parameters).infer(input, feeding, field)

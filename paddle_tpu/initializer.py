@@ -1,0 +1,80 @@
+"""Parameter initializers.
+
+Parity with the reference's parameter init policies (ParameterConfig proto
+initial_mean/initial_std/initial_strategy; Parameter::randomize). Xavier
+is the reference's default for weights (initial_std = 1/sqrt(fan_in), cf.
+config_parser.py default std semantics); constants for biases.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def __call__(self, rng, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, rng, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=0.01):
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, rng, shape, dtype):
+        return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-0.05, high=0.05):
+        self.low = low
+        self.high = high
+
+    def __call__(self, rng, shape, dtype):
+        return jax.random.uniform(rng, shape, dtype, self.low, self.high)
+
+
+class Xavier(Initializer):
+    """std = 1/sqrt(fan_in) normal — the reference's default weight init
+    (config_parser.py: initial_std defaults to 1/sqrt(input size))."""
+
+    def __init__(self, fan_in=None):
+        self.fan_in = fan_in
+
+    def __call__(self, rng, shape, dtype):
+        fan_in = self.fan_in
+        if fan_in is None:
+            fan_in = shape[0] if len(shape) > 1 else (shape[0] if shape else 1)
+        std = 1.0 / math.sqrt(max(fan_in, 1))
+        return std * jax.random.normal(rng, shape, dtype)
+
+
+class MSRA(Initializer):
+    """He init for relu conv stacks (std = sqrt(2/fan_in))."""
+
+    def __init__(self, fan_in=None):
+        self.fan_in = fan_in
+
+    def __call__(self, rng, shape, dtype):
+        fan_in = self.fan_in
+        if fan_in is None:
+            fan_in = shape[0] if len(shape) > 1 else 1
+        std = math.sqrt(2.0 / max(fan_in, 1))
+        return std * jax.random.normal(rng, shape, dtype)
+
+
+def default_weight_init(fan_in):
+    return Xavier(fan_in=fan_in)
+
+
+def default_bias_init():
+    return Constant(0.0)

@@ -1,0 +1,105 @@
+"""The layer library: ~90 layer constructors building a lazy DAG.
+
+Surface parity with python/paddle/v2/layer.py + trainer_config_helpers/
+layers.py (reference `__all__` at layers.py:33); execution is pure-JAX via
+paddle_tpu.topology.Topology. Families:
+
+  io.py        data
+  basic.py     fc, embedding, concat, addto, dropout, scaling, bias, ...
+  conv.py      img_conv, img_pool, batch_norm, img_cmrnorm, spp, maxout, ...
+  sequence.py  pooling, first/last_seq, expand, seq_* , context_projection,
+               row_conv, block_expand, max_id, sampling_id, eos_id, print
+  recurrent.py lstmemory, grumemory, recurrent
+  rnn_group.py recurrent_group, memory, beam_search, get_output
+  cost.py      classification_cost, cross_entropy, square_error, rank, ...
+  mixed.py     mixed + projections/operators
+  extra.py     nce, hsigmoid, crf, crf_decoding, ctc, warp_ctc, detection
+"""
+
+from paddle_tpu.graph import LayerNode, LayerOutput, reset_name_counters
+from paddle_tpu.layer.base import layer_registry
+
+from paddle_tpu.layer.io import data
+from paddle_tpu.layer.basic import (
+    addto,
+    bias,
+    concat,
+    cos_sim,
+    dropout,
+    embedding,
+    fc,
+    interpolation,
+    linear_comb,
+    power,
+    repeat,
+    resize,
+    scaling,
+    slope_intercept,
+    sum_to_one_norm,
+    trans,
+)
+from paddle_tpu.layer.conv import (
+    batch_norm,
+    bilinear_interp,
+    conv_shift,
+    crop,
+    img_cmrnorm,
+    img_conv,
+    img_pool,
+    maxout,
+    pad,
+    rotate,
+    spp,
+)
+from paddle_tpu.layer.sequence import (
+    block_expand,
+    context_projection_layer,
+    eos_id,
+    expand,
+    first_seq,
+    last_seq,
+    max_id,
+    maxid,
+    pooling,
+    print_layer,
+    row_conv,
+    sampling_id,
+    seq_concat,
+    seq_reshape,
+    seq_slice,
+    sub_seq,
+)
+from paddle_tpu.layer.cost import (
+    classification_cost,
+    cross_entropy,
+    cross_entropy_with_selfnorm,
+    huber_classification_cost,
+    huber_regression_cost,
+    lambda_cost,
+    mse_cost,
+    multi_binary_label_cross_entropy,
+    rank_cost,
+    regression_cost,
+    smooth_l1_cost,
+    square_error_cost,
+    sum_cost,
+)
+from paddle_tpu.layer.recurrent import grumemory, lstmemory, recurrent
+from paddle_tpu.layer.mixed import (
+    BaseProjection,
+    context_projection,
+    dotmul_operator,
+    dotmul_projection,
+    full_matrix_projection,
+    identity_projection,
+    mixed,
+    scaling_projection,
+    table_projection,
+    trans_full_matrix_projection,
+)
+
+# aliases matching v2 naming
+pooling_layer = pooling
+embedding_layer = embedding
+fc_layer = fc
+data_layer = data

@@ -1,0 +1,29 @@
+"""Attention helper layers (support for networks.simple_attention)."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layer.base import is_seq, make_node
+from paddle_tpu.utils.error import enforce
+
+
+def sequence_softmax_pool(scores, values, name=None):
+    """softmax the per-step scalar scores over time (masked), then weighted-
+    sum the value sequence -> one vector per sequence. This is the fused
+    tail of the reference's simple_attention (sequence_softmax activation +
+    scaling + pooling, trainer_config_helpers/networks.py)."""
+
+    def forward(params, vals, ctx):
+        s, v = vals[0], vals[1]
+        enforce(is_seq(s) and is_seq(v), "attention expects sequences")
+        logits = s.data[..., 0]
+        mask = s.mask()
+        neg = jnp.finfo(logits.dtype).min
+        masked = jnp.where(mask, logits, neg)
+        w = jnp.exp(masked - jnp.max(masked, axis=1, keepdims=True))
+        w = w * mask.astype(w.dtype)
+        w = w / jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1e-12)
+        return jnp.einsum("bt,btd->bd", w, v.data)
+
+    return make_node("attention_pool", forward, [scores, values], name=name,
+                     size=values.size)

@@ -1,0 +1,139 @@
+"""Layer-construction helpers shared by all layer families.
+
+The registry is REGISTER_LAYER parity (reference: gserver/layers/Layer.h
+REGISTER_LAYER macro + Layer::create factory); the helpers here encode the
+conventions every reference layer shared: multi-input weighted sums, default
+parameter naming (``<layer>.w0``/``.wbias``, matching the reference's
+convention so checkpoints are self-describing), activation + dropout
+application, and transparent per-timestep application over SequenceBatch.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.activation import to_activation
+from paddle_tpu.attr import ExtraAttr, ParamAttr
+from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
+from paddle_tpu.graph import LayerNode, ParamSpec
+from paddle_tpu.initializer import Constant, Normal, Xavier, default_bias_init
+from paddle_tpu.utils.error import enforce
+from paddle_tpu.utils.registry import Registry
+
+layer_registry = Registry("layer")
+
+
+def register_layer(name, aliases=()):
+    return layer_registry.register(name, aliases=aliases)
+
+
+def to_list(inputs):
+    if inputs is None:
+        return []
+    if isinstance(inputs, (list, tuple)):
+        return list(inputs)
+    return [inputs]
+
+
+def is_seq(value):
+    return isinstance(value, SequenceBatch)
+
+
+def featurewise(fn, value):
+    """Apply an elementwise/featurewise fn to an array or a SequenceBatch
+    (the reference applied non-sequence layers across the flattened time
+    dimension; padding rows are computed-and-masked here, which XLA fuses)."""
+    if isinstance(value, SequenceBatch):
+        return value.map_data(fn)
+    if isinstance(value, NestedSequenceBatch):
+        return NestedSequenceBatch(fn(value.data), value.outer_lengths, value.inner_lengths)
+    return fn(value)
+
+
+def data_of(value):
+    return value.data if isinstance(value, (SequenceBatch, NestedSequenceBatch)) else value
+
+
+def like(value, new_data):
+    """Rewrap new_data with value's sequence metadata."""
+    if isinstance(value, SequenceBatch):
+        return SequenceBatch(new_data, value.lengths)
+    if isinstance(value, NestedSequenceBatch):
+        return NestedSequenceBatch(new_data, value.outer_lengths, value.inner_lengths)
+    return new_data
+
+
+def weight_spec(layer_name, idx, shape, param_attr, fan_in=None):
+    attr = ParamAttr.to_attr(param_attr)
+    name = attr.name or "%s.w%d" % (layer_name, idx)
+    if attr.initializer is not None:
+        init = attr.initializer
+    elif attr.initial_std is not None:
+        init = Normal(attr.initial_mean, attr.initial_std)
+    else:
+        init = Xavier(fan_in=fan_in if fan_in is not None else shape[0])
+    return ParamSpec(name, shape, init, attr)
+
+
+def bias_spec(layer_name, shape, bias_attr):
+    """bias_attr semantics (reference layers.py): False -> no bias, None/True
+    -> default zero bias, ParamAttr -> custom."""
+    if bias_attr is False:
+        return None
+    attr = ParamAttr.to_attr(None if bias_attr is True else bias_attr)
+    name = attr.name or "%s.wbias" % layer_name
+    if attr.initializer is not None:
+        init = attr.initializer
+    elif attr.initial_std is not None:
+        init = Normal(attr.initial_mean, attr.initial_std)
+    else:
+        init = default_bias_init()
+    return ParamSpec(name, shape, init, attr)
+
+
+def mark_activation(node, act):
+    """Record the output activation name on the node so cost layers can tell
+    probabilities from logits (classification_cost switches to log-space on
+    softmax outputs — reference nets put Softmax on the output layer)."""
+    node.output_activation = to_activation(act).name
+    return node
+
+
+def finalize(x, act, extra_attr, ctx):
+    """Apply activation then (in train mode) dropout, per ExtraAttr
+    (cf. LayerConfig drop_rate; reference applies dropout on layer output)."""
+    act = to_activation(act)
+    out = featurewise(act.apply, x)
+    drop = extra_attr.drop_rate if extra_attr else None
+    if drop:
+        if ctx.is_train:
+            def dropped(d):
+                import jax
+
+                keep = 1.0 - drop
+                mask = jax.random.bernoulli(ctx.next_rng(), keep, d.shape)
+                return jnp.where(mask, d / keep, 0.0)
+
+            out = featurewise(dropped, out)
+    return out
+
+
+def infer_seq_level(inputs):
+    for v in inputs:
+        if isinstance(v, NestedSequenceBatch):
+            return 2
+        if isinstance(v, SequenceBatch):
+            return 1
+    return 0
+
+
+def make_node(layer_type, forward_fn, inputs, name=None, size=0, param_specs=(),
+              layer_attr=None, **kw):
+    return LayerNode(
+        layer_type,
+        forward_fn,
+        inputs=to_list(inputs),
+        name=name,
+        size=size,
+        param_specs=param_specs,
+        extra_attr=ExtraAttr.to_attr(layer_attr),
+        **kw,
+    )

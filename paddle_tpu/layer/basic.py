@@ -1,0 +1,322 @@
+"""Core dense layers: fc, embedding, concat, addto, dropout, scaling, etc.
+
+Parity targets (reference): FullyConnectedLayer (gserver/layers/
+FullyConnectedLayer.cpp), TableProjection/embedding, ConcatenateLayer,
+AddtoLayer, ScalingLayer, SlopeInterceptLayer, InterpolationLayer,
+PowerLayer, SumToOneNormLayer, BiasLayer, DropoutLayer (via drop_rate),
+CosSimLayer, LinearCombinationLayer, TransLayer, FeatureMapExpandLayer,
+RepeatLayer, ResizeLayer. All forwards are jnp programs; backward comes from
+jax.grad.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.graph import ParamSpec
+from paddle_tpu.initializer import Constant
+from paddle_tpu.layer.base import (
+    bias_spec,
+    data_of,
+    featurewise,
+    finalize,
+    infer_seq_level,
+    is_seq,
+    like,
+    make_node,
+    register_layer,
+    to_list,
+    weight_spec,
+)
+from paddle_tpu.utils.error import enforce
+
+
+@register_layer("fc")
+def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
+       layer_attr=None):
+    """Fully connected layer over one or more inputs (summed), with bias and
+    activation (reference: FullyConnectedLayer.cpp; v2 layer.fc)."""
+    inputs = to_list(input)
+    enforce(len(inputs) >= 1, "fc needs at least one input")
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("fc_layer")
+    attrs = param_attr if isinstance(param_attr, (list, tuple)) else [param_attr] * len(inputs)
+    specs = [
+        weight_spec(name, i, (inp.size, size), attrs[i], fan_in=inp.size)
+        for i, inp in enumerate(inputs)
+    ]
+    bspec = bias_spec(name, (size,), bias_attr)
+
+    def forward(params, values, ctx):
+        def matmul(value, spec):
+            w = params[spec.name]
+            return featurewise(lambda d: jnp.matmul(d, w), value)
+
+        out = matmul(values[0], specs[0])
+        for value, spec in zip(values[1:], specs[1:]):
+            nxt = matmul(value, spec)
+            out = like(out, data_of(out) + data_of(nxt))
+        if bspec is not None:
+            out = like(out, data_of(out) + params[bspec.name])
+        return finalize(out, act, node.extra_attr, ctx)
+
+    node = make_node(
+        "fc", forward, inputs, name=name, size=size,
+        param_specs=[s for s in specs + [bspec] if s is not None],
+        layer_attr=layer_attr,
+    )
+    from paddle_tpu.layer.base import mark_activation
+
+    return mark_activation(node, act)
+
+
+@register_layer("embedding")
+def embedding(input, size, name=None, param_attr=None, layer_attr=None):
+    """Embedding lookup (reference: TableProjection / embedding_layer;
+    mixed_layer(table_projection)). Input holds int32 ids; the table is a
+    dense [vocab, size] parameter gathered with jnp.take — on TPU this is an
+    XLA dynamic-gather riding HBM, the sparse-row machinery of the reference
+    (SparseRowCpuMatrix) maps to the sharded-embedding path in
+    paddle_tpu.parallel for the distributed case."""
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("embedding_layer")
+    vocab = input.size
+    spec = weight_spec(name, 0, (vocab, size), param_attr, fan_in=size)
+
+    def forward(params, values, ctx):
+        table = params[spec.name]
+        ids = values[0]
+
+        def gather(d):
+            return jnp.take(table, jnp.clip(d, 0, vocab - 1), axis=0)
+
+        return featurewise(gather, ids)
+
+    return make_node("embedding", forward, [input], name=name, size=size,
+                     param_specs=[spec], layer_attr=layer_attr)
+
+
+@register_layer("concat")
+def concat(input, name=None, act=None, layer_attr=None):
+    """Feature-axis concatenation (reference: ConcatenateLayer)."""
+    inputs = to_list(input)
+    size = sum(i.size for i in inputs)
+
+    def forward(params, values, ctx):
+        datas = [data_of(v) for v in values]
+        out = like(values[0], jnp.concatenate(datas, axis=-1))
+        return finalize(out, act, node.extra_attr, ctx)
+
+    node = make_node("concat", forward, inputs, name=name, size=size,
+                     layer_attr=layer_attr)
+    return node
+
+
+@register_layer("addto")
+def addto(input, name=None, act=None, bias_attr=False, layer_attr=None):
+    """Elementwise sum of inputs (reference: AddtoLayer)."""
+    inputs = to_list(input)
+    size = inputs[0].size
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("addto_layer")
+    bspec = bias_spec(name, (size,), bias_attr)
+
+    def forward(params, values, ctx):
+        out = data_of(values[0])
+        for v in values[1:]:
+            out = out + data_of(v)
+        if bspec is not None:
+            out = out + params[bspec.name]
+        return finalize(like(values[0], out), act, node.extra_attr, ctx)
+
+    node = make_node("addto", forward, inputs, name=name, size=size,
+                     param_specs=[bspec] if bspec else [],
+                     layer_attr=layer_attr)
+    from paddle_tpu.layer.base import mark_activation
+
+    return mark_activation(node, act)
+
+
+@register_layer("dropout")
+def dropout(input, dropout_rate, name=None):
+    """Standalone dropout layer (reference exposes dropout as layer_attr;
+    v2 also has layer.dropout)."""
+    from paddle_tpu.attr import ExtraAttr
+
+    def forward(params, values, ctx):
+        return finalize(values[0], None, node.extra_attr, ctx)
+
+    node = make_node("dropout", forward, [input], name=name, size=input.size,
+                     layer_attr=ExtraAttr(drop_rate=dropout_rate))
+    return node
+
+
+@register_layer("scaling")
+def scaling(input, weight, name=None, layer_attr=None):
+    """Row-wise scale: out[i,:] = w[i] * in[i,:] where weight is a size-1
+    layer (reference: ScalingLayer)."""
+
+    def forward(params, values, ctx):
+        x, w = data_of(values[0]), data_of(values[1])
+        return like(values[0], x * w)
+
+    return make_node("scaling", forward, [input, weight], name=name,
+                     size=input.size, layer_attr=layer_attr)
+
+
+@register_layer("slope_intercept")
+def slope_intercept(input, slope=1.0, intercept=0.0, name=None, layer_attr=None):
+    """out = slope * in + intercept (reference: SlopeInterceptLayer)."""
+
+    def forward(params, values, ctx):
+        return featurewise(lambda d: slope * d + intercept, values[0])
+
+    return make_node("slope_intercept", forward, [input], name=name,
+                     size=input.size, layer_attr=layer_attr)
+
+
+@register_layer("interpolation")
+def interpolation(input, weight, name=None, layer_attr=None):
+    """out = w*a + (1-w)*b; weight is a size-1 layer (reference:
+    InterpolationLayer)."""
+    inputs = to_list(input)
+    enforce(len(inputs) == 2, "interpolation needs exactly two inputs")
+
+    def forward(params, values, ctx):
+        a, b, w = data_of(values[0]), data_of(values[1]), data_of(values[2])
+        return like(values[0], w * a + (1.0 - w) * b)
+
+    return make_node("interpolation", forward, inputs + [weight], name=name,
+                     size=inputs[0].size, layer_attr=layer_attr)
+
+
+@register_layer("power")
+def power(input, weight, name=None, layer_attr=None):
+    """out[i,:] = in[i,:] ** w[i] (reference: PowerLayer)."""
+
+    def forward(params, values, ctx):
+        x, w = data_of(values[0]), data_of(values[1])
+        return like(values[0], jnp.power(x, w))
+
+    return make_node("power", forward, [input, weight], name=name,
+                     size=input.size, layer_attr=layer_attr)
+
+
+@register_layer("sum_to_one_norm")
+def sum_to_one_norm(input, name=None, layer_attr=None):
+    """Row-normalize to sum 1 (reference: SumToOneNormLayer)."""
+
+    def forward(params, values, ctx):
+        def norm(d):
+            return d / jnp.maximum(jnp.sum(d, axis=-1, keepdims=True), 1e-12)
+
+        return featurewise(norm, values[0])
+
+    return make_node("sum_to_one_norm", forward, [input], name=name,
+                     size=input.size, layer_attr=layer_attr)
+
+
+@register_layer("cos_sim")
+def cos_sim(a, b, scale=1.0, size=1, name=None, layer_attr=None):
+    """Cosine similarity (reference: CosSimLayer / function/CosSimOp). With
+    size>1, b is [B, size*dim] reshaped into `size` vectors each compared
+    against a."""
+
+    def forward(params, values, ctx):
+        x, y = data_of(values[0]), data_of(values[1])
+        if size > 1:
+            y = y.reshape(y.shape[:-1] + (size, x.shape[-1]))
+            xx = x[..., None, :]
+        else:
+            xx = x
+        dot = jnp.sum(xx * y, axis=-1)
+        nx = jnp.sqrt(jnp.maximum(jnp.sum(xx * xx, axis=-1), 1e-12))
+        ny = jnp.sqrt(jnp.maximum(jnp.sum(y * y, axis=-1), 1e-12))
+        out = scale * dot / (nx * ny)
+        if size == 1:
+            out = out[..., None]
+        return like(values[0], out)
+
+    return make_node("cos_sim", forward, [a, b], name=name, size=size,
+                     layer_attr=layer_attr)
+
+
+@register_layer("linear_comb")
+def linear_comb(weights, vectors, size, name=None, layer_attr=None):
+    """z = sum_i w[i] * x[i,:]: weights [B, M], vectors [B, M*size]
+    (reference: LinearCombinationLayer / ConvexCombinationLayer)."""
+
+    def forward(params, values, ctx):
+        w, v = data_of(values[0]), data_of(values[1])
+        m = w.shape[-1]
+        v = v.reshape(v.shape[:-1] + (m, size))
+        return like(values[0], jnp.einsum("...m,...ms->...s", w, v))
+
+    return make_node("linear_comb", forward, [weights, vectors], name=name,
+                     size=size, layer_attr=layer_attr)
+
+
+@register_layer("trans")
+def trans(input, name=None, layer_attr=None):
+    """Matrix transpose of the feature map [B, H*W] viewed as HxW — here the
+    batch-level transpose layer (reference: TransLayer transposes the
+    whole output matrix; used with fc weights). We transpose the trailing
+    two dims of a reshaped [B, h, w]."""
+
+    def forward(params, values, ctx):
+        x = data_of(values[0])
+        enforce(x.ndim >= 2, "trans expects matrix-like input")
+        return like(values[0], jnp.swapaxes(x, -1, -2))
+
+    return make_node("trans", forward, [input], name=name, size=input.size,
+                     layer_attr=layer_attr)
+
+
+@register_layer("repeat")
+def repeat(input, num_repeats, name=None, act=None, as_row_vector=True,
+           layer_attr=None):
+    """Tile features (reference: FeatureMapExpandLayer / RepeatLayer):
+    as_row_vector: [a b] -> [a b a b ...]; else [a a .. b b ..]."""
+
+    def forward(params, values, ctx):
+        x = data_of(values[0])
+        if as_row_vector:
+            out = jnp.tile(x, (1,) * (x.ndim - 1) + (num_repeats,))
+        else:
+            out = jnp.repeat(x, num_repeats, axis=-1)
+        return finalize(like(values[0], out), act, node.extra_attr, ctx)
+
+    node = make_node("repeat", forward, [input], name=name,
+                     size=input.size * num_repeats, layer_attr=layer_attr)
+    return node
+
+
+@register_layer("resize")
+def resize(input, size, name=None, layer_attr=None):
+    """Reshape [B, in] to [B*in/size, size] (reference: ResizeLayer)."""
+
+    def forward(params, values, ctx):
+        x = data_of(values[0])
+        return x.reshape(-1, size)
+
+    return make_node("resize", forward, [input], name=name, size=size,
+                     layer_attr=layer_attr)
+
+
+@register_layer("bias")
+def bias(input, name=None, act=None, bias_attr=None, layer_attr=None):
+    """Add a learned bias only (reference: BiasLayer via mixed/bias)."""
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("bias_layer")
+    bspec = bias_spec(name, (input.size,), bias_attr if bias_attr is not None else True)
+
+    def forward(params, values, ctx):
+        out = featurewise(lambda d: d + params[bspec.name], values[0])
+        return finalize(out, act, node.extra_attr, ctx)
+
+    node = make_node("bias", forward, [input], name=name, size=input.size,
+                     param_specs=[bspec], layer_attr=layer_attr)
+    return node

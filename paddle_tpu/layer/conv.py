@@ -1,0 +1,370 @@
+"""Image layers: conv, pooling, batch-norm, LRN, SPP, maxout, pad, crop, etc.
+
+Parity targets (reference): ExpandConvLayer/CudnnConvLayer (+ conv transpose),
+PoolLayer/CudnnPoolLayer, BatchNormLayer/CudnnBatchNormLayer,
+CrossMapNormalLayer (img_cmrnorm), SpatialPyramidPoolLayer, MaxOutLayer,
+PadLayer, CropLayer, RotateLayer, ConvShiftLayer, BlockExpandLayer,
+BilinearInterpLayer.
+
+Convention bridge: the reference flattens feature maps to [B, C*H*W] vectors
+between layers (LayerConfig.size) in NCHW order. Layers here accept that flat
+layout at graph edges, compute internally in NHWC (TPU-native), and flatten
+back, keeping NCHW element order so parameters/outputs match reference
+configs row-for-row. Each image-producing node records ``out_img_shape``
+(C, H, W) for downstream geometry inference, like config_parser's
+set_cnn_layer bookkeeping.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.graph import ParamSpec
+from paddle_tpu.initializer import Constant, Normal, Xavier
+from paddle_tpu.layer.base import (
+    bias_spec,
+    data_of,
+    finalize,
+    like,
+    make_node,
+    register_layer,
+    to_list,
+    weight_spec,
+)
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.utils.error import enforce
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+def _img_shape(node, num_channels=None):
+    """Infer (C, H, W) for a layer input (cf. config_parser geometry flow)."""
+    shape = getattr(node, "out_img_shape", None)
+    if shape is not None:
+        return shape
+    enforce(num_channels is not None,
+            "cannot infer image shape of %r; pass num_channels" % node.name)
+    hw = node.size // num_channels
+    side = int(round(hw ** 0.5))
+    enforce(side * side * num_channels == node.size,
+            "layer %r size %d is not square for %d channels"
+            % (node.name, node.size, num_channels))
+    return (num_channels, side, side)
+
+
+def _to_nhwc(flat, c, h, w):
+    return flat.reshape(-1, c, h, w).transpose(0, 2, 3, 1)
+
+
+def _to_flat(nhwc):
+    b, h, w, c = nhwc.shape
+    return nhwc.transpose(0, 3, 1, 2).reshape(b, c * h * w)
+
+
+@register_layer("img_conv")
+def img_conv(input, filter_size, num_filters, name=None, num_channels=None,
+             stride=1, padding=0, dilation=1, groups=1, act=None,
+             bias_attr=None, param_attr=None, shared_biases=True,
+             layer_attr=None, trans=False, filter_size_y=None, stride_y=None,
+             padding_y=None, caffe_mode=True):
+    """2-D convolution (reference: ExpandConvLayer = im2col+GEMM,
+    CudnnConvLayer; trans=True -> ConvTransLayer). On TPU this is one XLA
+    convolution instruction tiled onto the MXU — no im2col materialization."""
+    c, h, w = _img_shape(input, num_channels)
+    fh = int(filter_size_y if filter_size_y is not None else _pair(filter_size)[0])
+    fw = _pair(filter_size)[1]
+    sh = int(stride_y if stride_y is not None else _pair(stride)[0])
+    sw = _pair(stride)[1]
+    ph = int(padding_y if padding_y is not None else _pair(padding)[0])
+    pw = _pair(padding)[1]
+    dil = _pair(dilation)
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("conv_layer")
+    if trans:
+        oh, ow = (h - 1) * sh - 2 * ph + fh, (w - 1) * sw - 2 * pw + fw
+    else:
+        oh = conv_ops.out_size(h, fh + (fh - 1) * (dil[0] - 1), sh, ph, caffe_mode)
+        ow = conv_ops.out_size(w, fw + (fw - 1) * (dil[1] - 1), sw, pw, caffe_mode)
+    fan_in = c * fh * fw // groups
+    wspec = weight_spec(name, 0, (fh, fw, c // groups, num_filters), param_attr,
+                        fan_in=fan_in)
+    bshape = (num_filters,) if shared_biases else (num_filters * oh * ow,)
+    bspec = bias_spec(name, bshape, bias_attr)
+
+    def forward(params, values, ctx):
+        x = _to_nhwc(data_of(values[0]), c, h, w)
+        kernel = params[wspec.name]
+        if trans:
+            y = conv_ops.conv2d_transpose(
+                x, kernel, stride=(sh, sw),
+                padding=((ph, ph), (pw, pw)))
+        else:
+            y = conv_ops.conv2d(
+                x, kernel, stride=(sh, sw),
+                padding=((ph, ph), (pw, pw)), groups=groups, dilation=dil)
+        if bspec is not None:
+            if shared_biases:
+                y = y + params[bspec.name]
+                flat = _to_flat(y)
+            else:
+                flat = _to_flat(y) + params[bspec.name]
+        else:
+            flat = _to_flat(y)
+        return finalize(like(values[0], flat), act, node.extra_attr, ctx)
+
+    node = make_node("img_conv", forward, [input], name=name,
+                     size=num_filters * oh * ow,
+                     param_specs=[s for s in (wspec, bspec) if s is not None],
+                     layer_attr=layer_attr)
+    node.out_img_shape = (num_filters, oh, ow)
+    return node
+
+
+@register_layer("img_pool")
+def img_pool(input, pool_size, name=None, num_channels=None, pool_type=None,
+             stride=1, padding=0, layer_attr=None, pool_size_y=None,
+             stride_y=None, padding_y=None, ceil_mode=True,
+             exclude_mode=True):
+    """2-D max/avg pooling (reference: PoolLayer, CudnnPoolLayer)."""
+    from paddle_tpu.pooling import AvgPooling, MaxPooling, to_pooling
+
+    c, h, w = _img_shape(input, num_channels)
+    fh = int(pool_size_y if pool_size_y is not None else _pair(pool_size)[0])
+    fw = _pair(pool_size)[1]
+    sh = int(stride_y if stride_y is not None else _pair(stride)[0])
+    sw = _pair(stride)[1]
+    ph = int(padding_y if padding_y is not None else _pair(padding)[0])
+    pw = _pair(padding)[1]
+    ptype = to_pooling(pool_type)
+    if ceil_mode:
+        oh = -(-(h + 2 * ph - fh) // sh) + 1
+        ow = -(-(w + 2 * pw - fw) // sw) + 1
+    else:
+        oh = (h + 2 * ph - fh) // sh + 1
+        ow = (w + 2 * pw - fw) // sw + 1
+
+    def forward(params, values, ctx):
+        x = _to_nhwc(data_of(values[0]), c, h, w)
+        if isinstance(ptype, MaxPooling):
+            y = conv_ops.max_pool2d(x, (fh, fw), (sh, sw), (ph, pw), ceil_mode)
+        else:
+            y = conv_ops.avg_pool2d(x, (fh, fw), (sh, sw), (ph, pw), ceil_mode,
+                                    exclude_padding=exclude_mode)
+        y = y[:, :oh, :ow, :]
+        return like(values[0], _to_flat(y))
+
+    node = make_node("img_pool", forward, [input], name=name, size=c * oh * ow,
+                     layer_attr=layer_attr)
+    node.out_img_shape = (c, oh, ow)
+    return node
+
+
+@register_layer("batch_norm")
+def batch_norm(input, name=None, num_channels=None, act=None, bias_attr=None,
+               param_attr=None, layer_attr=None, use_global_stats=None,
+               moving_average_fraction=0.9, epsilon=1e-5, img3D=False):
+    """Batch normalization (reference: BatchNormLayer / BatchNormBaseLayer;
+    moving stats are running state threaded through Context.update_state —
+    the JAX-functional version of the reference's in-place moving-average
+    parameter buffers)."""
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("batch_norm_layer")
+    shape = getattr(input, "out_img_shape", None)
+    channels = shape[0] if shape else (num_channels or input.size)
+    gamma = weight_spec(name, 0, (channels,), param_attr, fan_in=channels)
+    if gamma.attr.initial_std is None and gamma.attr.initializer is None:
+        gamma.initializer = Constant(1.0)
+    beta = bias_spec(name, (channels,), bias_attr if bias_attr is not None else True)
+    mean_spec = ParamSpec(name + ".moving_mean", (channels,), Constant(0.0),
+                          is_state=True)
+    var_spec = ParamSpec(name + ".moving_var", (channels,), Constant(1.0),
+                         is_state=True)
+
+    def forward(params, values, ctx):
+        flat = data_of(values[0])
+        g, b = params[gamma.name], params[beta.name]
+        mm, mv = params[mean_spec.name], params[var_spec.name]
+        if shape:
+            c, h, w = shape
+            x = _to_nhwc(flat, c, h, w)
+            axes = (0, 1, 2)
+        else:
+            x = flat
+            axes = (0,)
+        use_stats = use_global_stats if use_global_stats is not None else not ctx.is_train
+        if use_stats:
+            y = conv_ops.batch_norm_infer(x, g, b, mm, mv, epsilon)
+        else:
+            y, new_mean, new_var = conv_ops.batch_norm_train(
+                x, g, b, mm, mv, axes, moving_average_fraction, epsilon)
+            ctx.update_state(mean_spec.name, new_mean)
+            ctx.update_state(var_spec.name, new_var)
+        out = _to_flat(y) if shape else y
+        return finalize(like(values[0], out), act, node.extra_attr, ctx)
+
+    node = make_node("batch_norm", forward, [input], name=name, size=input.size,
+                     param_specs=[gamma, beta, mean_spec, var_spec],
+                     layer_attr=layer_attr)
+    if shape:
+        node.out_img_shape = shape
+    return node
+
+
+@register_layer("img_cmrnorm")
+def img_cmrnorm(input, size, scale=0.0128, power=0.75, name=None,
+                num_channels=None, layer_attr=None):
+    """Local response normalization across channel maps (reference:
+    CMRProjectionNormLayer via norm_layer; function/CrossMapNormalOp)."""
+    c, h, w = _img_shape(input, num_channels)
+
+    def forward(params, values, ctx):
+        x = _to_nhwc(data_of(values[0]), c, h, w)
+        y = conv_ops.cross_map_norm(x, size, scale * size, power)
+        return like(values[0], _to_flat(y))
+
+    node = make_node("img_cmrnorm", forward, [input], name=name,
+                     size=input.size, layer_attr=layer_attr)
+    node.out_img_shape = (c, h, w)
+    return node
+
+
+@register_layer("spp")
+def spp(input, name=None, num_channels=None, pool_type=None, pyramid_height=3,
+        layer_attr=None):
+    """Spatial pyramid pooling (reference: SpatialPyramidPoolLayer)."""
+    from paddle_tpu.pooling import MaxPooling, to_pooling
+
+    c, h, w = _img_shape(input, num_channels)
+    ptype = "max" if isinstance(to_pooling(pool_type), MaxPooling) else "avg"
+    total_bins = sum(4 ** l for l in range(pyramid_height))
+
+    def forward(params, values, ctx):
+        x = _to_nhwc(data_of(values[0]), c, h, w)
+        return like(values[0], conv_ops.spatial_pyramid_pool(x, pyramid_height, ptype))
+
+    return make_node("spp", forward, [input], name=name, size=total_bins * c,
+                     layer_attr=layer_attr)
+
+
+@register_layer("maxout")
+def maxout(input, groups, name=None, num_channels=None, layer_attr=None):
+    """Maxout over channel groups (reference: MaxOutLayer)."""
+    c, h, w = _img_shape(input, num_channels)
+    enforce(c % groups == 0, "maxout channels %d not divisible by groups %d", c, groups)
+
+    def forward(params, values, ctx):
+        x = _to_nhwc(data_of(values[0]), c, h, w)
+        return like(values[0], _to_flat(conv_ops.maxout(x, groups)))
+
+    node = make_node("maxout", forward, [input], name=name,
+                     size=input.size // groups, layer_attr=layer_attr)
+    node.out_img_shape = (c // groups, h, w)
+    return node
+
+
+@register_layer("pad")
+def pad(input, pad_c=None, pad_h=None, pad_w=None, name=None, layer_attr=None):
+    """Zero-pad C/H/W axes (reference: PadLayer, function/PadOp)."""
+    shape = getattr(input, "out_img_shape", None)
+    enforce(shape is not None, "pad layer needs an image-shaped input")
+    c, h, w = shape
+    pc = tuple(pad_c or (0, 0))
+    ph = tuple(pad_h or (0, 0))
+    pw = tuple(pad_w or (0, 0))
+    oc, ohh, oww = c + sum(pc), h + sum(ph), w + sum(pw)
+
+    def forward(params, values, ctx):
+        x = data_of(values[0]).reshape(-1, c, h, w)
+        y = jnp.pad(x, ((0, 0), pc, ph, pw))
+        return like(values[0], y.reshape(-1, oc * ohh * oww))
+
+    node = make_node("pad", forward, [input], name=name, size=oc * ohh * oww,
+                     layer_attr=layer_attr)
+    node.out_img_shape = (oc, ohh, oww)
+    return node
+
+
+@register_layer("crop")
+def crop(input, axis, offset, shape=None, name=None, layer_attr=None):
+    """Crop NCHW dims from ``axis`` onward to reference-layer shape
+    (reference: CropLayer, function/CropOp). ``input`` may be [data, ref]."""
+    inputs = to_list(input)
+    src = inputs[0]
+    c, h, w = _img_shape(src)
+    if shape is None:
+        enforce(len(inputs) == 2, "crop needs a shape or a reference input")
+        shape = (1,) + tuple(inputs[1].out_img_shape)
+    full = (1, c, h, w)
+    out = list(full)
+    offs = [0, 0, 0, 0]
+    for i in range(axis, 4):
+        out[i] = shape[i]
+        offs[i] = offset[i - axis] if i - axis < len(offset) else 0
+    oc, oh, ow = out[1], out[2], out[3]
+
+    def forward(params, values, ctx):
+        x = data_of(values[0]).reshape(-1, c, h, w)
+        y = x[:, offs[1]: offs[1] + oc, offs[2]: offs[2] + oh, offs[3]: offs[3] + ow]
+        return like(values[0], y.reshape(-1, oc * oh * ow))
+
+    node = make_node("crop", forward, inputs, name=name, size=oc * oh * ow,
+                     layer_attr=layer_attr)
+    node.out_img_shape = (oc, oh, ow)
+    return node
+
+
+@register_layer("rotate")
+def rotate(input, height, width, name=None, layer_attr=None):
+    """Rotate each feature map 90° counter-clockwise (reference: RotateLayer)."""
+    c = input.size // (height * width)
+
+    def forward(params, values, ctx):
+        x = data_of(values[0]).reshape(-1, c, height, width)
+        y = jnp.rot90(x, k=1, axes=(2, 3))
+        return like(values[0], y.reshape(-1, c * height * width))
+
+    node = make_node("rotate", forward, [input], name=name, size=input.size,
+                     layer_attr=layer_attr)
+    node.out_img_shape = (c, width, height)
+    return node
+
+
+@register_layer("conv_shift")
+def conv_shift(a, b, name=None, layer_attr=None):
+    """Circular 1-D convolution: out[i] = sum_j a[i+j-floor(N/2)] * b[j]
+    (reference: ConvShiftLayer)."""
+    def forward(params, values, ctx):
+        x, k = data_of(values[0]), data_of(values[1])
+        n = k.shape[-1]
+        half = n // 2
+        outs = []
+        for j in range(n):
+            outs.append(jnp.roll(x, half - j, axis=-1) * k[..., j: j + 1])
+        return like(values[0], sum(outs))
+
+    return make_node("conv_shift", forward, [a, b], name=name, size=a.size,
+                     layer_attr=layer_attr)
+
+
+@register_layer("bilinear_interp")
+def bilinear_interp(input, out_size_x, out_size_y, name=None, layer_attr=None):
+    """Bilinear upsampling (reference: BilinearInterpLayer)."""
+    c, h, w = _img_shape(input)
+
+    def forward(params, values, ctx):
+        import jax
+
+        x = _to_nhwc(data_of(values[0]), c, h, w)
+        y = jax.image.resize(
+            x, (x.shape[0], out_size_y, out_size_x, c), method="linear")
+        return like(values[0], _to_flat(y))
+
+    node = make_node("bilinear_interp", forward, [input], name=name,
+                     size=c * out_size_x * out_size_y, layer_attr=layer_attr)
+    node.out_img_shape = (c, out_size_y, out_size_x)
+    return node

@@ -1,0 +1,31 @@
+"""Data (input) layers.
+
+Parity: DataLayer (reference: gserver/layers/DataLayer.h) + v2 layer.data
+(python/paddle/v2/layer.py). A data layer is a typed graph leaf; its
+InputType drives feed conversion (topology.convert_feed) exactly like the
+reference's DataConfig + DataProviderConverter pair.
+"""
+
+from paddle_tpu.data_type import InputType
+from paddle_tpu.graph import LayerNode
+from paddle_tpu.layer.base import register_layer
+from paddle_tpu.utils.error import enforce
+
+
+@register_layer("data")
+def data(name, type, layer_attr=None):
+    enforce(isinstance(type, InputType), "layer.data 'type' must be an InputType")
+
+    def forward(params, inputs, ctx):
+        return inputs[0]
+
+    node = LayerNode(
+        "data",
+        forward,
+        inputs=(),
+        name=name,
+        size=type.dim,
+        seq_level=type.seq_type,
+    )
+    node.input_type = type
+    return node

@@ -1,0 +1,236 @@
+"""Mixed layer: a sum of projections and operators.
+
+Parity: MixedLayer + Projection/Operator registries (reference:
+gserver/layers/MixedLayer.cpp, Projection.h, Operator.h; DSL
+trainer_config_helpers mixed_layer with full_matrix_projection etc.).
+A projection owns parameters (full_matrix, table, context, dotmul, scaling,
+trans_full_matrix, identity); an operator is parameter-free (dot_mul, conv).
+The mixed layer sums all branch outputs, then bias + activation.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layer.base import (
+    bias_spec,
+    data_of,
+    featurewise,
+    finalize,
+    is_seq,
+    like,
+    make_node,
+    register_layer,
+    to_list,
+    weight_spec,
+)
+from paddle_tpu.ops import sequence as seq_ops
+from paddle_tpu.utils.error import enforce
+
+
+class BaseProjection:
+    """One branch of a mixed layer. Subclasses declare specs via
+    build(layer_name, idx) and compute via forward(params, value, ctx)."""
+
+    def __init__(self, input, size=None, param_attr=None):
+        self.input = input
+        self.size = size
+        self.param_attr = param_attr
+        self.specs = []
+
+    def build(self, layer_name, idx):
+        return []
+
+    def forward(self, params, value, ctx):
+        raise NotImplementedError
+
+
+class full_matrix_projection(BaseProjection):
+    """out = in * W (reference: FullMatrixProjection)."""
+
+    def build(self, layer_name, idx):
+        spec = weight_spec(layer_name, idx, (self.input.size, self.size),
+                           self.param_attr, fan_in=self.input.size)
+        self.specs = [spec]
+        return self.specs
+
+    def forward(self, params, value, ctx):
+        w = params[self.specs[0].name]
+        return featurewise(lambda d: jnp.matmul(d, w), value)
+
+
+class trans_full_matrix_projection(BaseProjection):
+    """out = in * W^T (reference: TransposedFullMatrixProjection)."""
+
+    def build(self, layer_name, idx):
+        spec = weight_spec(layer_name, idx, (self.size, self.input.size),
+                           self.param_attr, fan_in=self.input.size)
+        self.specs = [spec]
+        return self.specs
+
+    def forward(self, params, value, ctx):
+        w = params[self.specs[0].name]
+        return featurewise(lambda d: jnp.matmul(d, w.T), value)
+
+
+class identity_projection(BaseProjection):
+    """Pass-through, optionally offset into the input features
+    (reference: IdentityProjection / IdentityOffsetProjection)."""
+
+    def __init__(self, input, offset=0, size=None):
+        super().__init__(input, size or input.size - offset)
+        self.offset = offset
+
+    def forward(self, params, value, ctx):
+        off, size = self.offset, self.size
+        return featurewise(lambda d: d[..., off: off + size], value)
+
+
+class table_projection(BaseProjection):
+    """Embedding lookup of integer ids (reference: TableProjection)."""
+
+    def build(self, layer_name, idx):
+        spec = weight_spec(layer_name, idx, (self.input.size, self.size),
+                           self.param_attr, fan_in=self.size)
+        self.specs = [spec]
+        return self.specs
+
+    def forward(self, params, value, ctx):
+        table = params[self.specs[0].name]
+        vocab = table.shape[0]
+        return featurewise(
+            lambda d: jnp.take(table, jnp.clip(d, 0, vocab - 1), axis=0), value)
+
+
+class dotmul_projection(BaseProjection):
+    """out = in ∘ w, w a [size] vector (reference: DotMulProjection)."""
+
+    def __init__(self, input, param_attr=None):
+        super().__init__(input, input.size, param_attr)
+
+    def build(self, layer_name, idx):
+        spec = weight_spec(layer_name, idx, (self.size,), self.param_attr,
+                           fan_in=1)
+        self.specs = [spec]
+        return self.specs
+
+    def forward(self, params, value, ctx):
+        w = params[self.specs[0].name]
+        return featurewise(lambda d: d * w, value)
+
+
+class scaling_projection(BaseProjection):
+    """out = s * in, s a scalar parameter (reference: ScalingProjection)."""
+
+    def __init__(self, input, param_attr=None):
+        super().__init__(input, input.size, param_attr)
+
+    def build(self, layer_name, idx):
+        spec = weight_spec(layer_name, idx, (1,), self.param_attr, fan_in=1)
+        self.specs = [spec]
+        return self.specs
+
+    def forward(self, params, value, ctx):
+        w = params[self.specs[0].name]
+        return featurewise(lambda d: d * w[0], value)
+
+
+class context_projection(BaseProjection):
+    """Sliding-window concat over a sequence (reference: ContextProjection)."""
+
+    def __init__(self, input, context_start=-1, context_len=3,
+                 trainable_padding=False, param_attr=None):
+        super().__init__(input, input.size * context_len, param_attr)
+        self.context_start = context_start
+        self.context_len = context_len
+        self.trainable_padding = trainable_padding
+
+    def build(self, layer_name, idx):
+        if self.trainable_padding:
+            total_pad = max(0, -self.context_start) + max(
+                0, self.context_start + self.context_len - 1)
+            spec = weight_spec(layer_name, idx,
+                               (max(total_pad, 1), self.input.size),
+                               self.param_attr, fan_in=self.input.size)
+            self.specs = [spec]
+        return self.specs
+
+    def forward(self, params, value, ctx):
+        enforce(is_seq(value), "context_projection expects a sequence")
+        padding = params[self.specs[0].name] if self.specs else None
+        out = seq_ops.context_projection(
+            value.data, value.mask(), self.context_start, self.context_len,
+            padding)
+        return SequenceBatch(out, value.lengths)
+
+
+class dotmul_operator:
+    """Parameter-free elementwise product scaled (reference: DotMulOperator)."""
+
+    def __init__(self, a, b, scale=1.0):
+        self.inputs = [a, b]
+        self.size = a.size
+        self.scale = scale
+
+    def forward_op(self, values, ctx):
+        return like(values[0], self.scale * data_of(values[0]) * data_of(values[1]))
+
+
+@register_layer("mixed")
+def mixed(size=None, input=None, name=None, act=None, bias_attr=False,
+          layer_attr=None):
+    """Sum of projections/operators + bias + activation (reference:
+    MixedLayer.cpp; DSL mixed_layer)."""
+    branches = to_list(input)
+    enforce(len(branches) > 0, "mixed layer needs at least one projection")
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("mixed")
+    # infer size
+    sizes = set()
+    for br in branches:
+        if isinstance(br, BaseProjection):
+            if br.size is None:
+                br.size = size
+            sizes.add(br.size)
+        else:
+            sizes.add(br.size)
+    enforce(len(sizes) == 1, "mixed branches disagree on size: %s", sizes)
+    size = size or sizes.pop()
+
+    specs = []
+    graph_inputs = []
+    branch_slots = []  # (projection_or_operator, [input slot indices])
+    for i, br in enumerate(branches):
+        if isinstance(br, BaseProjection):
+            specs.extend(br.build(name, i))
+            graph_inputs.append(br.input)
+            branch_slots.append((br, [len(graph_inputs) - 1]))
+        elif isinstance(br, dotmul_operator):
+            idxs = []
+            for node_in in br.inputs:
+                graph_inputs.append(node_in)
+                idxs.append(len(graph_inputs) - 1)
+            branch_slots.append((br, idxs))
+        else:
+            raise TypeError("mixed input must be projections/operators, got %r" % br)
+    bspec = bias_spec(name, (size,), bias_attr)
+    if bspec is not None:
+        specs.append(bspec)
+
+    def forward(params, values, ctx):
+        total = None
+        for br, idxs in branch_slots:
+            if isinstance(br, BaseProjection):
+                out = br.forward(params, values[idxs[0]], ctx)
+            else:
+                out = br.forward_op([values[j] for j in idxs], ctx)
+            total = out if total is None else like(out, data_of(total) + data_of(out))
+        if bspec is not None:
+            total = like(total, data_of(total) + params[bspec.name])
+        return finalize(total, act, node.extra_attr, ctx)
+
+    node = make_node("mixed", forward, graph_inputs, name=name, size=size,
+                     param_specs=specs, layer_attr=layer_attr)
+    from paddle_tpu.layer.base import mark_activation
+
+    return mark_activation(node, act)

@@ -1,0 +1,143 @@
+"""Recurrent layers: lstmemory, grumemory, simple recurrent.
+
+Parity targets (reference): LstmLayer (gserver/layers/LstmLayer.cpp, fused
+kernels hl_cuda_lstm.cu), GatedRecurrentLayer (GruCompute), RecurrentLayer.
+Contract parity: like the reference, ``lstmemory`` consumes an input already
+projected to 4*size (the user puts an fc/mixed layer in front — see
+networks.simple_lstm), ``grumemory`` consumes 3*size, ``recurrent`` consumes
+size. The recurrent_group / memory / beam-search machinery
+(RecurrentGradientMachine parity) lives in paddle_tpu/layer/rnn_group.py.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.activation import to_activation
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layer.base import (
+    bias_spec,
+    is_seq,
+    make_node,
+    register_layer,
+    weight_spec,
+)
+from paddle_tpu.ops import rnn as rnn_ops
+from paddle_tpu.utils.error import enforce
+
+
+@register_layer("lstmemory")
+def lstmemory(input, name=None, size=None, reverse=False, act=None,
+              gate_act=None, state_act=None, bias_attr=None, param_attr=None,
+              use_peephole=False, layer_attr=None):
+    """LSTM over a pre-projected sequence (input.size == 4*size).
+
+    reference: LstmLayer.cpp:LstmLayer (project_input done by prior layer);
+    act = cell-output activation (default tanh), gate_act sigmoid,
+    state_act candidate/cell activation (default tanh).
+    """
+    size = size or input.size // 4
+    enforce(input.size == 4 * size, "lstmemory input.size must be 4*size")
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("lstmemory")
+    wspec = weight_spec(name, 0, (size, 4 * size), param_attr, fan_in=size)
+    bspec = bias_spec(name, (4 * size,), bias_attr)
+    pspec = (
+        weight_spec(name + ".peephole", 1, (3 * size,), param_attr, fan_in=size)
+        if use_peephole
+        else None
+    )
+    g_act = to_activation(gate_act or "sigmoid").apply
+    s_act = to_activation(state_act or "tanh").apply
+    o_act = to_activation(act or "tanh").apply
+
+    def forward(params, values, ctx):
+        x = values[0]
+        enforce(is_seq(x), "lstmemory expects a sequence input")
+        gates = x.data
+        if bspec is not None:
+            gates = gates + params[bspec.name]
+        h_seq, _ = rnn_ops.lstm_scan(
+            gates,
+            x.mask(gates.dtype),
+            w_in=None,
+            b=None,
+            w_rec=params[wspec.name],
+            gate_act=g_act,
+            state_act=s_act,
+            reverse=reverse,
+            use_peephole=use_peephole,
+            w_peep=params[pspec.name] if pspec else None,
+        )
+        return SequenceBatch(h_seq, x.lengths)
+
+    specs = [s for s in (wspec, bspec, pspec) if s is not None]
+    return make_node("lstmemory", forward, [input], name=name, size=size,
+                     param_specs=specs, layer_attr=layer_attr)
+
+
+@register_layer("grumemory")
+def grumemory(input, name=None, size=None, reverse=False, act=None,
+              gate_act=None, bias_attr=None, param_attr=None, layer_attr=None):
+    """GRU over a pre-projected sequence (input.size == 3*size)
+    (reference: GatedRecurrentLayer)."""
+    size = size or input.size // 3
+    enforce(input.size == 3 * size, "grumemory input.size must be 3*size")
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("grumemory")
+    w_rz = weight_spec(name, 0, (size, 2 * size), param_attr, fan_in=size)
+    w_c = weight_spec(name, 1, (size, size), param_attr, fan_in=size)
+    bspec = bias_spec(name, (3 * size,), bias_attr)
+    g_act = to_activation(gate_act or "sigmoid").apply
+    s_act = to_activation(act or "tanh").apply
+
+    def forward(params, values, ctx):
+        x = values[0]
+        enforce(is_seq(x), "grumemory expects a sequence input")
+        proj = x.data
+        if bspec is not None:
+            proj = proj + params[bspec.name]
+        h_seq, _ = rnn_ops.gru_scan(
+            proj,
+            x.mask(proj.dtype),
+            w_in=None,
+            b=None,
+            w_rec_rz=params[w_rz.name],
+            w_rec_c=params[w_c.name],
+            gate_act=g_act,
+            state_act=s_act,
+            reverse=reverse,
+        )
+        return SequenceBatch(h_seq, x.lengths)
+
+    specs = [s for s in (w_rz, w_c, bspec) if s is not None]
+    return make_node("grumemory", forward, [input], name=name, size=size,
+                     param_specs=specs, layer_attr=layer_attr)
+
+
+@register_layer("recurrent")
+def recurrent(input, name=None, act=None, reverse=False, bias_attr=None,
+              param_attr=None, layer_attr=None):
+    """Vanilla recurrent layer over a pre-projected sequence (reference:
+    RecurrentLayer; input.size == size)."""
+    size = input.size
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("recurrent_layer")
+    wspec = weight_spec(name, 0, (size, size), param_attr, fan_in=size)
+    bspec = bias_spec(name, (size,), bias_attr)
+    act_fn = to_activation(act or "tanh").apply
+
+    def forward(params, values, ctx):
+        x = values[0]
+        enforce(is_seq(x), "recurrent expects a sequence input")
+        inp = x.data
+        if bspec is not None:
+            inp = inp + params[bspec.name]
+        h_seq, _ = rnn_ops.rnn_scan(
+            inp, x.mask(inp.dtype), params[wspec.name], act=act_fn, reverse=reverse)
+        return SequenceBatch(h_seq, x.lengths)
+
+    specs = [s for s in (wspec, bspec) if s is not None]
+    return make_node("recurrent", forward, [input], name=name, size=size,
+                     param_specs=specs, layer_attr=layer_attr)

@@ -1,0 +1,379 @@
+"""Sequence-structure layers.
+
+Parity targets (reference, gserver/layers/): SequencePoolLayer family
+(pooling_layer -> Max/Average/SumPooling over time), SequenceLastInstanceLayer
+(last_seq/first_seq), ExpandLayer, SequenceConcatLayer, SequenceReshapeLayer,
+SubSequenceLayer, SeqSliceLayer, ContextProjection (as a layer here),
+RowConvLayer, BlockExpandLayer (image -> sequence of patches), MaxIdLayer,
+SamplingIdLayer, EosIdCheckLayer, PrintLayer. The reference walks
+sequenceStartPositions with scatter/gather kernels; here everything is
+mask/shift algebra on [B, T, D] which XLA fuses (see ops/sequence.py).
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
+from paddle_tpu.layer.base import (
+    data_of,
+    finalize,
+    is_seq,
+    like,
+    make_node,
+    register_layer,
+    to_list,
+    weight_spec,
+)
+from paddle_tpu.ops import sequence as seq_ops
+from paddle_tpu.pooling import to_pooling
+from paddle_tpu.utils.error import enforce
+
+
+@register_layer("pooling")
+def pooling(input, pooling_type=None, name=None, bias_attr=False, agg_level=0,
+            layer_attr=None):
+    """Pool a sequence to one vector per sequence (reference:
+    SequencePoolLayer + Max/Average/SumPooling; agg_level selects nested
+    inner pooling: AggregateLevel.TO_SEQUENCE pools each sub-sequence)."""
+    ptype = to_pooling(pooling_type)
+
+    def forward(params, values, ctx):
+        x = values[0]
+        if isinstance(x, NestedSequenceBatch):
+            if agg_level:  # pool each sub-sequence -> outer SequenceBatch
+                inner = x.flatten_to_subsequences()
+                pooled = ptype.reduce(inner.data, inner.mask())
+                return x.outer_sequence_of(pooled)
+            flat = x.flatten_to_subsequences()
+            seq = SequenceBatch(flat.data, flat.lengths)
+            pooled = ptype.reduce(seq.data, seq.mask())
+            b = x.batch_size
+            grouped = pooled.reshape(b, x.max_subseqs, -1)
+            m = x.outer_mask(grouped.dtype)[..., None]
+            if ptype.name == "max":
+                neg = jnp.finfo(grouped.dtype).min
+                grouped = jnp.where(m > 0, grouped, neg)
+                return jnp.max(grouped, axis=1)
+            total = jnp.sum(grouped * m, axis=1)
+            if ptype.name == "sum":
+                return total
+            count = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+            return total / count
+        enforce(isinstance(x, SequenceBatch), "pooling expects a sequence input")
+        return ptype.reduce(x.data, x.mask())
+
+    return make_node("pooling", forward, [input], name=name, size=input.size,
+                     layer_attr=layer_attr)
+
+
+@register_layer("last_seq")
+def last_seq(input, name=None, agg_level=0, layer_attr=None):
+    """Last timestep of each sequence (reference: SequenceLastInstanceLayer)."""
+
+    def forward(params, values, ctx):
+        x = values[0]
+        if isinstance(x, NestedSequenceBatch):
+            if agg_level:
+                inner = x.flatten_to_subsequences()
+                return x.outer_sequence_of(inner.last_step())
+            x = SequenceBatch(
+                x.flatten_to_subsequences().data, x.flatten_to_subsequences().lengths
+            )
+        return x.last_step()
+
+    return make_node("last_seq", forward, [input], name=name, size=input.size,
+                     layer_attr=layer_attr)
+
+
+@register_layer("first_seq")
+def first_seq(input, name=None, agg_level=0, layer_attr=None):
+    """First timestep of each sequence (reference: SequenceLastInstanceLayer
+    with select_first)."""
+
+    def forward(params, values, ctx):
+        x = values[0]
+        if isinstance(x, NestedSequenceBatch):
+            if agg_level:
+                inner = x.flatten_to_subsequences()
+                return x.outer_sequence_of(inner.first_step())
+            return x.data[:, 0, 0]
+        return x.first_step()
+
+    return make_node("first_seq", forward, [input], name=name, size=input.size,
+                     layer_attr=layer_attr)
+
+
+@register_layer("expand")
+def expand(input, expand_as, name=None, bias_attr=False, expand_level=0,
+           layer_attr=None):
+    """Broadcast per-sequence rows across the timesteps of ``expand_as``
+    (reference: ExpandLayer)."""
+
+    def forward(params, values, ctx):
+        x, target = values[0], values[1]
+        enforce(is_seq(target), "expand_as input must be a sequence")
+        xd = data_of(x)
+        if is_seq(x):  # outer sequence expanded into nested target handled upstream
+            xd = x.data
+        out = seq_ops.expand_to(xd, target.mask())
+        return SequenceBatch(out, target.lengths)
+
+    return make_node("expand", forward, [input, expand_as], name=name,
+                     size=input.size, layer_attr=layer_attr)
+
+
+@register_layer("seq_concat")
+def seq_concat(a, b, name=None, act=None, bias_attr=False, layer_attr=None):
+    """Concatenate two sequences in time per sample (reference:
+    SequenceConcatLayer)."""
+
+    def forward(params, values, ctx):
+        xa, xb = values[0], values[1]
+        enforce(is_seq(xa) and is_seq(xb), "seq_concat expects sequences")
+        b_, ta, d = xa.data.shape
+        tb = xb.data.shape[1]
+        total = ta + tb
+        # place a's valid steps first, then b's, via scatter on time indices
+        t = jnp.arange(total)[None, :]
+        la = xa.lengths[:, None]
+        lb = xb.lengths[:, None]
+        from_a = t < la
+        from_b = (t >= la) & (t < la + lb)
+        idx_a = jnp.clip(t, 0, ta - 1)
+        idx_b = jnp.clip(t - la, 0, tb - 1)
+        ga = jnp.take_along_axis(xa.data, idx_a[..., None], axis=1)
+        gb = jnp.take_along_axis(xb.data, idx_b[..., None], axis=1)
+        out = jnp.where(from_a[..., None], ga, jnp.where(from_b[..., None], gb, 0.0))
+        node_out = SequenceBatch(out, xa.lengths + xb.lengths)
+        return finalize(node_out, act, node.extra_attr, ctx)
+
+    node = make_node("seq_concat", forward, [a, b], name=name, size=a.size,
+                     layer_attr=layer_attr)
+    return node
+
+
+@register_layer("seq_reshape")
+def seq_reshape(input, reshape_size, name=None, act=None, bias_attr=False,
+                layer_attr=None):
+    """Reshape sequence feature width, redistributing timesteps (reference:
+    SequenceReshapeLayer): total elements per sequence are preserved."""
+
+    def forward(params, values, ctx):
+        x = values[0]
+        enforce(is_seq(x), "seq_reshape expects a sequence")
+        b, t, d = x.data.shape
+        enforce((t * d) % reshape_size == 0, "cannot reshape %dx%d to width %d",
+                t, d, reshape_size)
+        new_t = t * d // reshape_size
+        data = x.masked_data().reshape(b, new_t, reshape_size)
+        new_len = (x.lengths * d) // reshape_size
+        out = SequenceBatch(data, new_len)
+        return finalize(out, act, node.extra_attr, ctx)
+
+    node = make_node("seq_reshape", forward, [input], name=name,
+                     size=reshape_size, layer_attr=layer_attr)
+    return node
+
+
+@register_layer("seq_slice")
+def seq_slice(input, starts=None, ends=None, name=None, layer_attr=None):
+    """Slice each sequence by per-sample [start, end) (reference:
+    SeqSliceLayer). starts/ends are size-1 layers or None."""
+    inputs = [input] + [x for x in (starts, ends) if x is not None]
+
+    def forward(params, values, ctx):
+        x = values[0]
+        enforce(is_seq(x), "seq_slice expects a sequence")
+        idx = 1
+        if starts is not None:
+            s = data_of(values[idx]).reshape(-1).astype(jnp.int32)
+            idx += 1
+        else:
+            s = jnp.zeros((x.batch_size,), jnp.int32)
+        if ends is not None:
+            e = data_of(values[idx]).reshape(-1).astype(jnp.int32)
+        else:
+            e = x.lengths
+        t = jnp.arange(x.max_len)[None, :]
+        gather_idx = jnp.clip(t + s[:, None], 0, x.max_len - 1)
+        data = jnp.take_along_axis(
+            x.data, gather_idx[..., None].repeat(x.data.shape[-1], -1), axis=1)
+        new_len = jnp.clip(e - s, 0, x.lengths)
+        mask = t < new_len[:, None]
+        return SequenceBatch(data * mask[..., None], new_len)
+
+    return make_node("seq_slice", forward, inputs, name=name, size=input.size,
+                     layer_attr=layer_attr)
+
+
+@register_layer("sub_seq")
+def sub_seq(input, offsets, sizes, name=None, act=None, bias_attr=False,
+            layer_attr=None):
+    """Take a sub-range of each sequence by offset/size layers (reference:
+    SubSequenceLayer)."""
+
+    def forward(params, values, ctx):
+        x, off, sz = values[0], data_of(values[1]), data_of(values[2])
+        off = off.reshape(-1).astype(jnp.int32)
+        sz = sz.reshape(-1).astype(jnp.int32)
+        t = jnp.arange(x.max_len)[None, :]
+        gather_idx = jnp.clip(t + off[:, None], 0, x.max_len - 1)
+        data = jnp.take_along_axis(
+            x.data, gather_idx[..., None].repeat(x.data.shape[-1], -1), axis=1)
+        new_len = jnp.minimum(sz, jnp.maximum(x.lengths - off, 0))
+        mask = (t < new_len[:, None]).astype(data.dtype)
+        return SequenceBatch(data * mask[..., None], new_len)
+
+    return make_node("sub_seq", forward, [input, offsets, sizes], name=name,
+                     size=input.size, layer_attr=layer_attr)
+
+
+@register_layer("context_projection_layer")
+def context_projection_layer(input, context_start, context_len,
+                             trainable_padding=False, name=None,
+                             param_attr=None, layer_attr=None):
+    """Standalone context projection (reference: ContextProjection, usually
+    inside mixed_layer; also exposed via text_conv networks)."""
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("context_projection")
+    specs = []
+    if trainable_padding:
+        total_pad = max(0, -context_start) + max(0, context_start + context_len - 1)
+        pspec = weight_spec(name, 0, (max(total_pad, 1), input.size), param_attr,
+                            fan_in=input.size)
+        specs.append(pspec)
+
+    def forward(params, values, ctx):
+        x = values[0]
+        enforce(is_seq(x), "context projection expects a sequence")
+        padding = params[specs[0].name] if specs else None
+        out = seq_ops.context_projection(
+            x.data, x.mask(), context_start, context_len, padding)
+        return SequenceBatch(out, x.lengths)
+
+    return make_node("context_projection", forward, [input], name=name,
+                     size=input.size * context_len, param_specs=specs,
+                     layer_attr=layer_attr)
+
+
+@register_layer("row_conv")
+def row_conv(input, context_len, act=None, name=None, param_attr=None,
+             layer_attr=None):
+    """Lookahead convolution (reference: RowConvLayer, function/RowConvOp)."""
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("row_conv_layer")
+    wspec = weight_spec(name, 0, (context_len, input.size), param_attr,
+                        fan_in=context_len)
+
+    def forward(params, values, ctx):
+        x = values[0]
+        enforce(is_seq(x), "row_conv expects a sequence")
+        out = seq_ops.row_conv(x.data, x.mask(), params[wspec.name])
+        return finalize(SequenceBatch(out, x.lengths), act, node.extra_attr, ctx)
+
+    node = make_node("row_conv", forward, [input], name=name, size=input.size,
+                     param_specs=[wspec], layer_attr=layer_attr)
+    return node
+
+
+@register_layer("block_expand")
+def block_expand(input, block_x, block_y, stride_x=None, stride_y=None,
+                 padding_x=0, padding_y=0, num_channels=None, name=None,
+                 layer_attr=None):
+    """Image -> sequence of flattened patches (reference: BlockExpandLayer,
+    function/BlockExpandOp; feeds CTC OCR pipelines). Output: a sequence of
+    length out_h*out_w with feature block_y*block_x*C per step."""
+    from paddle_tpu.layer.conv import _img_shape
+
+    c, h, w = _img_shape(input, num_channels)
+    sx = stride_x or block_x
+    sy = stride_y or block_y
+    out_w = (w + 2 * padding_x - block_x) // sx + 1
+    out_h = (h + 2 * padding_y - block_y) // sy + 1
+
+    def forward(params, values, ctx):
+        x = data_of(values[0]).reshape(-1, c, h, w)
+        x = jnp.pad(x, ((0, 0), (0, 0), (padding_y, padding_y), (padding_x, padding_x)))
+        patches = []
+        for i in range(out_h):
+            for j in range(out_w):
+                patch = x[:, :, i * sy: i * sy + block_y, j * sx: j * sx + block_x]
+                patches.append(patch.reshape(x.shape[0], -1))
+        data = jnp.stack(patches, axis=1)  # [B, out_h*out_w, C*by*bx]
+        lengths = jnp.full((x.shape[0],), out_h * out_w, jnp.int32)
+        return SequenceBatch(data, lengths)
+
+    return make_node("block_expand", forward, [input], name=name,
+                     size=block_x * block_y * c, layer_attr=layer_attr)
+
+
+@register_layer("max_id")
+def max_id(input, name=None, layer_attr=None):
+    """Argmax over features (reference: MaxIdLayer; feeds beam/eval)."""
+
+    def forward(params, values, ctx):
+        def am(d):
+            return jnp.argmax(d, axis=-1).astype(jnp.int32)
+
+        x = values[0]
+        if is_seq(x):
+            return SequenceBatch(am(x.data), x.lengths)
+        return am(x)
+
+    return make_node("max_id", forward, [input], name=name, size=1,
+                     layer_attr=layer_attr)
+
+
+maxid = max_id
+
+
+@register_layer("sampling_id")
+def sampling_id(input, name=None, layer_attr=None):
+    """Sample an id from a probability row (reference: SamplingIdLayer)."""
+
+    def forward(params, values, ctx):
+        import jax
+
+        x = data_of(values[0])
+        logits = jnp.log(jnp.maximum(x, 1e-20))
+        return jax.random.categorical(ctx.next_rng(), logits, axis=-1).astype(jnp.int32)
+
+    return make_node("sampling_id", forward, [input], name=name, size=1,
+                     layer_attr=layer_attr)
+
+
+@register_layer("eos_id")
+def eos_id(input, eos_id, name=None, layer_attr=None):
+    """1 where input id == eos (reference: EosIdCheckLayer)."""
+
+    def forward(params, values, ctx):
+        x = values[0]
+
+        def check(d):
+            return (d == eos_id).astype(jnp.int32)
+
+        if is_seq(x):
+            return SequenceBatch(check(x.data), x.lengths)
+        return check(x)
+
+    return make_node("eos_id", forward, [input], name=name, size=1,
+                     layer_attr=layer_attr)
+
+
+@register_layer("print")
+def print_layer(input, format=None, name=None):
+    """Debug print during trace (reference: PrintLayer) via jax.debug.print."""
+    inputs = to_list(input)
+
+    def forward(params, values, ctx):
+        import jax
+
+        for node_in, v in zip(inputs, values):
+            jax.debug.print(
+                (format or "{name}: {value}"), name=node_in.name, value=data_of(v)
+            )
+        return values[0]
+
+    return make_node("print", forward, inputs, name=name,
+                     size=inputs[0].size)

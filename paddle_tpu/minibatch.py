@@ -1,0 +1,20 @@
+"""Minibatch assembly (parity: python/paddle/v2/minibatch.py)."""
+
+
+def batch(reader, batch_size, drop_last=True):
+    """Group a sample reader into a minibatch reader. ``drop_last=True``
+    keeps every batch the same size — on TPU this avoids a recompile for a
+    ragged final batch (the reference kept partial batches; here dropping
+    is the default and the trainer pads when asked to keep them)."""
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

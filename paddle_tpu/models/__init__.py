@@ -1,0 +1,7 @@
+"""Model zoo: reference demo/benchmark topologies rebuilt on paddle_tpu
+(parity targets: v1_api_demo/mnist LeNet & vgg, benchmark/paddle alexnet/
+googlenet/smallnet, benchmark/paddle/rnn IMDB LSTM, model_zoo resnet,
+quick_start text models, sequence_tagging BiLSTM-CRF, seq2seq NMT)."""
+
+from paddle_tpu.models import vision
+from paddle_tpu.models import text

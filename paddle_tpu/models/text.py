@@ -1,0 +1,75 @@
+"""Text/sequence model topologies (reference configs cited per function)."""
+
+from paddle_tpu import activation as A
+from paddle_tpu import data_type
+from paddle_tpu import layer as L
+from paddle_tpu import networks
+from paddle_tpu import pooling as pool
+
+
+def text_classification_lr(dict_size=30000, num_classes=2):
+    """Logistic regression over bag of words (reference: v1_api_demo/
+    quick_start trainer_config.lr.py)."""
+    words = L.data(name="word", type=data_type.sparse_binary_vector(dict_size))
+    out = L.fc(input=words, size=num_classes, act=A.Softmax(), name="lr_out")
+    return out
+
+
+def text_classification_cnn(dict_size=30000, emb_size=128, hidden=128,
+                            num_classes=2):
+    """Text CNN (reference: quick_start trainer_config.cnn.py —
+    embedding + context window conv + max pooling)."""
+    words = L.data(name="word", type=data_type.integer_value_sequence(dict_size))
+    emb = L.embedding(input=words, size=emb_size, name="cnn_emb")
+    conv = networks.sequence_conv_pool(input=emb, context_len=3,
+                                       hidden_size=hidden, name="cnn_conv")
+    return L.fc(input=conv, size=num_classes, act=A.Softmax(), name="cnn_out")
+
+
+def text_classification_lstm(dict_size=30000, emb_size=128, hidden=128,
+                             num_classes=2, num_layers=1):
+    """Stacked-LSTM text classification (reference: quick_start
+    trainer_config.lstm.py and benchmark/paddle/rnn/rnn.py — the RNN
+    benchmark model: 2x LSTM + fc over IMDB)."""
+    words = L.data(name="word", type=data_type.integer_value_sequence(dict_size))
+    emb = L.embedding(input=words, size=emb_size, name="lstm_emb")
+    t = emb
+    for i in range(num_layers):
+        t = networks.simple_lstm(input=t, size=hidden, name="lstm%d" % i)
+    pooled = L.pooling(input=t, pooling_type=pool.MaxPooling())
+    return L.fc(input=pooled, size=num_classes, act=A.Softmax(),
+                name="lstm_out")
+
+
+def sequence_tagging_rnn(word_dict_size=5000, label_dict_size=67,
+                         emb_size=64, hidden=128):
+    """BiLSTM tagger emitting per-step label scores (reference:
+    v1_api_demo/sequence_tagging rnn_crf.py minus the CRF head — the CRF
+    layer attaches via layer.crf in the demo script)."""
+    words = L.data(name="word",
+                   type=data_type.integer_value_sequence(word_dict_size))
+    emb = L.embedding(input=words, size=emb_size, name="tag_emb")
+    fwd = networks.simple_lstm(input=emb, size=hidden, name="tag_fwd")
+    bwd = networks.simple_lstm(input=emb, size=hidden, reverse=True,
+                               name="tag_bwd")
+    merged = L.concat(input=[fwd, bwd], name="tag_concat")
+    return L.fc(input=merged, size=label_dict_size, act=None,
+                name="tag_scores")
+
+
+def ngram_lm(dict_size=2000, emb_size=32, hidden=64, gram_n=4):
+    """N-gram neural LM (reference: v1_api_demo word embedding demo /
+    imikolov usage)."""
+    grams = [L.data(name="w%d" % i, type=data_type.integer_value(dict_size))
+             for i in range(gram_n)]
+    embs = [L.embedding(input=g, size=emb_size,
+                        param_attr=__shared_emb_attr()) for g in grams]
+    merged = L.concat(input=embs, name="ngram_concat")
+    h = L.fc(input=merged, size=hidden, act=A.Relu(), name="ngram_h")
+    return L.fc(input=h, size=dict_size, act=A.Softmax(), name="ngram_out")
+
+
+def __shared_emb_attr():
+    from paddle_tpu.attr import ParamAttr
+
+    return ParamAttr(name="ngram_emb_table")

@@ -1,0 +1,151 @@
+"""Composite network builders.
+
+Parity with trainer_config_helpers/networks.py (reference: simple_img_conv_pool,
+img_conv_bn_pool, simple_lstm, bidirectional_lstm, simple_gru, simple_attention,
+sequence_conv_pool (text conv), vgg_16_network, simple_img_conv_pool).
+"""
+
+from paddle_tpu import activation as act_mod
+from paddle_tpu import layer as L
+from paddle_tpu import pooling as pool_mod
+from paddle_tpu.utils.error import enforce
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size, name=None,
+                         pool_type=None, act=None, groups=1, conv_stride=1,
+                         conv_padding=0, bias_attr=None, num_channel=None,
+                         param_attr=None, pool_stride=1, pool_padding=0):
+    conv = L.img_conv(input=input, filter_size=filter_size,
+                      num_filters=num_filters, num_channels=num_channel,
+                      stride=conv_stride, padding=conv_padding, groups=groups,
+                      act=act, bias_attr=bias_attr, param_attr=param_attr,
+                      name="%s_conv" % name if name else None)
+    return L.img_pool(input=conv, pool_size=pool_size, pool_type=pool_type,
+                      stride=pool_stride, padding=pool_padding,
+                      name="%s_pool" % name if name else None)
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size, name=None,
+                     pool_type=None, act=None, groups=1, conv_stride=1,
+                     conv_padding=0, conv_bias_attr=None, num_channel=None,
+                     conv_param_attr=None, pool_stride=1, pool_padding=0,
+                     bn_param_attr=None, bn_bias_attr=None):
+    conv = L.img_conv(input=input, filter_size=filter_size,
+                      num_filters=num_filters, num_channels=num_channel,
+                      stride=conv_stride, padding=conv_padding, groups=groups,
+                      act=None, bias_attr=conv_bias_attr,
+                      param_attr=conv_param_attr,
+                      name="%s_conv" % name if name else None)
+    bn = L.batch_norm(input=conv, act=act, param_attr=bn_param_attr,
+                      bias_attr=bn_bias_attr,
+                      name="%s_bn" % name if name else None)
+    return L.img_pool(input=bn, pool_size=pool_size, pool_type=pool_type,
+                      stride=pool_stride, padding=pool_padding,
+                      name="%s_pool" % name if name else None)
+
+
+def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
+                bias_param_attr=None, inner_param_attr=None, act=None,
+                gate_act=None, state_act=None):
+    """fc (4*size projection) + lstmemory (reference: simple_lstm)."""
+    proj = L.fc(input=input, size=size * 4, act=None, bias_attr=False,
+                param_attr=mat_param_attr,
+                name="%s_transform" % name if name else None)
+    return L.lstmemory(input=proj, size=size, reverse=reverse, act=act,
+                       gate_act=gate_act, state_act=state_act,
+                       bias_attr=bias_param_attr, param_attr=inner_param_attr,
+                       name=name)
+
+
+def bidirectional_lstm(input, size, name=None, return_seq=False,
+                       fwd_act=None, bwd_act=None, **kwargs):
+    """Forward + backward LSTM, concat (reference: bidirectional_lstm);
+    return_seq=False pools last (fwd) / first (bwd) steps."""
+    fwd = simple_lstm(input, size, name="%s_fwd" % name if name else None,
+                      reverse=False, act=fwd_act)
+    bwd = simple_lstm(input, size, name="%s_bwd" % name if name else None,
+                      reverse=True, act=bwd_act)
+    if return_seq:
+        return L.concat(input=[fwd, bwd], name=name)
+    fwd_last = L.last_seq(input=fwd)
+    bwd_first = L.first_seq(input=bwd)
+    return L.concat(input=[fwd_last, bwd_first], name=name)
+
+
+def simple_gru(input, size, name=None, reverse=False, mat_param_attr=None,
+               bias_param_attr=None, inner_param_attr=None, act=None,
+               gate_act=None):
+    proj = L.fc(input=input, size=size * 3, act=None, bias_attr=False,
+                param_attr=mat_param_attr,
+                name="%s_transform" % name if name else None)
+    return L.grumemory(input=proj, size=size, reverse=reverse, act=act,
+                       gate_act=gate_act, bias_attr=bias_param_attr,
+                       param_attr=inner_param_attr, name=name)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, name=None,
+                       context_start=None, pool_type=None, context_proj_param_attr=None,
+                       fc_param_attr=None, fc_bias_attr=None, fc_act=None,
+                       pool_bias_attr=None, fc_attr=None):
+    """Text convolution: context window + fc + sequence pooling (reference:
+    sequence_conv_pool / text_conv_pool)."""
+    start = context_start if context_start is not None else -(context_len // 2)
+    ctx = L.context_projection_layer(
+        input=input, context_start=start, context_len=context_len,
+        trainable_padding=context_proj_param_attr is not None,
+        param_attr=context_proj_param_attr,
+        name="%s_conv_proj" % name if name else None)
+    fc = L.fc(input=ctx, size=hidden_size, act=fc_act, param_attr=fc_param_attr,
+              bias_attr=fc_bias_attr, name="%s_conv_fc" % name if name else None)
+    return L.pooling(input=fc, pooling_type=pool_type, name=name,
+                     bias_attr=pool_bias_attr)
+
+
+text_conv_pool = sequence_conv_pool
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     name=None):
+    """Additive attention (reference: simple_attention): score each encoder
+    step against the decoder state, softmax over time, weighted sum."""
+    decoder_proj = L.fc(input=decoder_state, size=encoded_proj.size,
+                        act=None, bias_attr=False,
+                        param_attr=transform_param_attr,
+                        name="%s_transform" % name if name else None)
+    expanded = L.expand(input=decoder_proj, expand_as=encoded_proj)
+    combined = L.addto(input=[encoded_proj, expanded],
+                       act=act_mod.Tanh())
+    scores = L.fc(input=combined, size=1, act=None, bias_attr=False,
+                  param_attr=softmax_param_attr,
+                  name="%s_scores" % name if name else None)
+    from paddle_tpu.layer.attention_utils import sequence_softmax_pool
+
+    return sequence_softmax_pool(scores, encoded_sequence, name=name)
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    """VGG-16 (reference: vgg_16_network in networks.py)."""
+
+    def conv_block(ipt, num_filter, groups, num_channels_=None, name=None):
+        blk = ipt
+        for i in range(groups):
+            blk = L.img_conv(input=blk, filter_size=3, num_filters=num_filter,
+                             num_channels=num_channels_ if i == 0 else None,
+                             padding=1, act=act_mod.Relu(),
+                             name="%s_conv%d" % (name, i) if name else None)
+        return L.img_pool(input=blk, pool_size=2, stride=2,
+                          name="%s_pool" % name if name else None)
+
+    tmp = conv_block(input_image, 64, 2, num_channels, name="vgg1")
+    tmp = conv_block(tmp, 128, 2, name="vgg2")
+    tmp = conv_block(tmp, 256, 3, name="vgg3")
+    tmp = conv_block(tmp, 512, 3, name="vgg4")
+    tmp = conv_block(tmp, 512, 3, name="vgg5")
+    tmp = L.fc(input=tmp, size=4096, act=act_mod.Relu(),
+               layer_attr=None, name="vgg_fc1")
+    tmp = L.dropout(input=tmp, dropout_rate=0.5)
+    tmp = L.fc(input=tmp, size=4096, act=act_mod.Relu(), name="vgg_fc2")
+    tmp = L.dropout(input=tmp, dropout_rate=0.5)
+    return L.fc(input=tmp, size=num_classes, act=act_mod.Softmax(),
+                name="vgg_out")

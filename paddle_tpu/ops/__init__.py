@@ -1,0 +1,13 @@
+"""Functional kernel layer.
+
+TPU-native equivalent of paddle/function + paddle/cuda's hl_* kernels +
+paddle/math Matrix virtuals: pure jnp/lax functions (XLA HLO) with Pallas
+kernels where fusion needs help (paddle_tpu/ops/pallas_kernels.py). No
+CPU/GPU kernel pairs — XLA targets every backend from one definition, and
+the CPU-vs-TPU equivalence tests (reference pattern: Compare2Function,
+paddle/function/FunctionTest.h) become CPU-vs-TPU jit checks.
+"""
+
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import rnn as rnn_ops
+from paddle_tpu.ops import sequence as sequence_ops

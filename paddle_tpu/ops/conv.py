@@ -1,0 +1,163 @@
+"""Convolution / pooling / normalization kernels.
+
+Replaces the reference's conv stack — GemmConvOp (im2col+GEMM,
+paddle/function/GemmConvOp.cpp), DepthwiseConvOp, cuDNN bindings
+(hl_cuda_cudnn.cc), pooling kernels, CrossMapNormalOp — with
+lax.conv_general_dilated / lax.reduce_window, which XLA tiles directly onto
+the MXU. Layout is NHWC (TPU-native); the layer wrappers translate from the
+reference's flattened NCHW vector convention at the graph edge.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dtype import matmul_precision
+
+
+def conv2d(x_nhwc, w_hwio, stride=(1, 1), padding="SAME", groups=1, dilation=(1, 1)):
+    return lax.conv_general_dilated(
+        x_nhwc,
+        w_hwio,
+        window_strides=stride,
+        padding=padding,
+        rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+        precision=matmul_precision(),
+    )
+
+
+def conv2d_transpose(x_nhwc, w_hwio, stride=(1, 1), padding="SAME"):
+    return lax.conv_transpose(
+        x_nhwc,
+        w_hwio,
+        strides=stride,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=matmul_precision(),
+    )
+
+
+def out_size(in_size, filter_size, stride, padding, caffe_mode=True):
+    """Spatial output size, reference semantics (config_parser.py cnn_output_size):
+    caffe_mode: (in + 2*pad - filter)/stride + 1 (floor);
+    else: (in + 2*pad - filter + stride - 1)/stride + 1 (ceil)."""
+    if caffe_mode:
+        return (in_size + 2 * padding - filter_size) // stride + 1
+    return (in_size + 2 * padding - filter_size + stride - 1) // stride + 1
+
+
+def explicit_pad(padding_hw):
+    ph, pw = padding_hw
+    return ((ph, ph), (pw, pw))
+
+
+def max_pool2d(x_nhwc, window, stride, padding=(0, 0), ceil_mode=True):
+    pads = _pool_pads(x_nhwc, window, stride, padding, ceil_mode)
+    # -inf (not finfo.min) keeps reduce_window max differentiable
+    return lax.reduce_window(
+        x_nhwc,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1,) + window + (1,),
+        window_strides=(1,) + stride + (1,),
+        padding=((0, 0),) + pads + ((0, 0),),
+    )
+
+
+def avg_pool2d(x_nhwc, window, stride, padding=(0, 0), ceil_mode=True,
+               exclude_padding=True):
+    pads = _pool_pads(x_nhwc, window, stride, padding, ceil_mode)
+    summed = lax.reduce_window(
+        x_nhwc,
+        0.0,
+        lax.add,
+        window_dimensions=(1,) + window + (1,),
+        window_strides=(1,) + stride + (1,),
+        padding=((0, 0),) + pads + ((0, 0),),
+    )
+    if exclude_padding:
+        ones = jnp.ones(x_nhwc.shape[:3] + (1,), x_nhwc.dtype)
+        counts = lax.reduce_window(
+            ones,
+            0.0,
+            lax.add,
+            window_dimensions=(1,) + window + (1,),
+            window_strides=(1,) + stride + (1,),
+            padding=((0, 0),) + pads + ((0, 0),),
+        )
+        return summed / jnp.maximum(counts, 1.0)
+    return summed / float(window[0] * window[1])
+
+
+def _pool_pads(x, window, stride, padding, ceil_mode):
+    """Reference pooling uses ceil output size (config_parser.py
+    pool_output_size with ceil), which may need extra low-side padding."""
+    pads = []
+    for axis, (w, s, p) in enumerate(zip(window, stride, padding)):
+        in_size = x.shape[1 + axis]
+        if ceil_mode:
+            out = -(-(in_size + 2 * p - w) // s) + 1
+        else:
+            out = (in_size + 2 * p - w) // s + 1
+        needed = max((out - 1) * s + w - in_size - p, p)
+        pads.append((p, needed))
+    return tuple(pads)
+
+
+def batch_norm_train(x, gamma, beta, moving_mean, moving_var, axes, momentum, eps):
+    """Returns (y, new_mean, new_var). ``axes`` are reduce axes (all but the
+    channel axis). Reference: BatchNormLayer / CudnnBatchNormLayer with
+    moving_average_fraction (ModelConfig moving_average_fraction)."""
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    y = gamma * (x - mean) / jnp.sqrt(var + eps) + beta
+    new_mean = momentum * moving_mean + (1.0 - momentum) * mean
+    new_var = momentum * moving_var + (1.0 - momentum) * var
+    return y, new_mean, new_var
+
+
+def batch_norm_infer(x, gamma, beta, moving_mean, moving_var, eps):
+    return gamma * (x - moving_mean) / jnp.sqrt(moving_var + eps) + beta
+
+
+def cross_map_norm(x_nhwc, size, scale, power):
+    """Local response normalization across channels (reference:
+    CrossMapNormalOp, paddle/function/CrossMapNormalOp.cpp):
+    out = x / (1 + scale/size * sum_{window} x^2)^power."""
+    half = size // 2
+    sq = x_nhwc * x_nhwc
+    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, size - 1 - half)))
+    window = sum(
+        padded[..., i : i + x_nhwc.shape[-1]] for i in range(size)
+    )
+    denom = (1.0 + (scale / size) * window) ** power
+    return x_nhwc / denom
+
+
+def spatial_pyramid_pool(x_nhwc, pyramid_height, pool="max"):
+    """SPP (reference: SpatialPyramidPoolLayer): concat of pooled maps at
+    1x1, 2x2, ... 2^(h-1) x 2^(h-1) grids -> [B, sum(4^l) * C]."""
+    b, h, w, c = x_nhwc.shape
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        wh, ww = -(-h // bins), -(-w // bins)
+        sh, sw = h // bins if h >= bins else 1, w // bins if w >= bins else 1
+        wh, ww = max(wh, 1), max(ww, 1)
+        fn = max_pool2d if pool == "max" else avg_pool2d
+        pooled = fn(x_nhwc, (wh, ww), (max(sh, 1), max(sw, 1)))
+        pooled = pooled[:, :bins, :bins, :]
+        outs.append(pooled.reshape(b, -1))
+    return jnp.concatenate(outs, axis=-1)
+
+
+def maxout(x_nhwc, groups):
+    """Maxout over channel groups (reference: MaxOutLayer): channels C are
+    split into C/groups output channels, taking max over each group."""
+    b, h, w, c = x_nhwc.shape
+    out_c = c // groups
+    return jnp.max(x_nhwc.reshape(b, h, w, out_c, groups), axis=-1)

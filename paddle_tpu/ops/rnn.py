@@ -1,0 +1,200 @@
+"""Recurrent kernels: LSTM/GRU/vanilla-RNN steps and masked scan runners.
+
+Replaces the reference's fused recurrent CUDA kernels (paddle/cuda/
+hl_cuda_lstm.cu ~700 LoC, hl_gpu_gru.cuh, LstmCompute.cu/GruCompute.cu) and
+the SequenceToBatch batch-major repacking (gserver/layers/SequenceToBatch.cpp).
+TPU-native shape: the input-to-hidden projection for ALL timesteps is one big
+[B*T, D] x [D, 4H] matmul (MXU-friendly), then a lax.scan carries only the
+small recurrent h/c state with the [H, 4H] recurrent matmul per step; masking
+freezes state past each sequence's end — exactly the effect the reference got
+from sorting sequences by length and shrinking the active batch.
+
+Gate layout follows the reference checkpoint convention (hl_lstm weights):
+[input, forget, cell(candidate), output] concatenated on the last axis.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dtype import matmul_precision
+
+
+def _mm(a, b):
+    return jnp.matmul(a, b, precision=matmul_precision())
+
+
+def lstm_step(carry, gates_t, w_rec, mask_t, gate_act, state_act,
+              use_peephole=False, w_peep=None):
+    """One LSTM step. carry=(h, c); gates_t [B, 4H] is the precomputed
+    input projection (+bias); w_rec [H, 4H]. Matches the reference's
+    hl_lstm gate math (hl_cuda_lstm.cu): i,f = sigmoid, candidate g and
+    output transform via ``state_act`` (tanh default)."""
+    h_prev, c_prev = carry
+    hidden = gates_t.shape[-1] // 4
+    z = gates_t + _mm(h_prev, w_rec)
+    zi, zf, zg, zo = jnp.split(z, 4, axis=-1)
+    if use_peephole:
+        pi, pf, po = jnp.split(w_peep, 3, axis=-1)
+        zi = zi + c_prev * pi
+        zf = zf + c_prev * pf
+    i = gate_act(zi)
+    f = gate_act(zf)
+    g = state_act(zg)
+    c = f * c_prev + i * g
+    if use_peephole:
+        zo = zo + c * po
+    o = gate_act(zo)
+    h = o * state_act(c)
+    m = mask_t[:, None]
+    h = jnp.where(m, h, h_prev)
+    c = jnp.where(m, c, c_prev)
+    return (h, c), h
+
+
+def gru_step(carry, inp_t, w_rec_rz, w_rec_c, mask_t, gate_act, state_act):
+    """One GRU step, reference gate order (hl_gpu_gru.cuh): update z,
+    reset r, candidate c. inp_t [B, 3H] precomputed input projection."""
+    h_prev = carry
+    xu, xr, xc = jnp.split(inp_t, 3, axis=-1)
+    rz = _mm(h_prev, w_rec_rz)
+    zu_r, zr_r = jnp.split(rz, 2, axis=-1)
+    u = gate_act(xu + zu_r)
+    r = gate_act(xr + zr_r)
+    c = state_act(xc + _mm(r * h_prev, w_rec_c))
+    h = u * h_prev + (1.0 - u) * c
+    m = mask_t[:, None]
+    h = jnp.where(m, h, h_prev)
+    return h, h
+
+
+def rnn_step(carry, inp_t, w_rec, mask_t, act):
+    h_prev = carry
+    h = act(inp_t + _mm(h_prev, w_rec))
+    m = mask_t[:, None]
+    h = jnp.where(m, h, h_prev)
+    return h, h
+
+
+def _scan_time_major(step_fn, init_carry, inputs_tm, mask_tm, reverse=False):
+    def body(carry, xs):
+        inp_t, m_t = xs
+        return step_fn(carry, inp_t, m_t)
+
+    carry, ys = lax.scan(body, init_carry, (inputs_tm, mask_tm), reverse=reverse)
+    return carry, ys
+
+
+def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
+              gate_act=jax.nn.sigmoid, state_act=jnp.tanh, reverse=False,
+              use_peephole=False, w_peep=None):
+    """Full-sequence LSTM. x [B, T, D] -> h_seq [B, T, H], (h_T, c_T).
+
+    The [B*T, D]x[D, 4H] projection runs outside the scan (one MXU GEMM);
+    the scan body is the small [B, H]x[H, 4H] recurrent GEMM + elementwise.
+    ``reverse=True`` runs right-to-left *within each sequence* — because
+    state updates are masked, trailing padding passes through untouched,
+    reproducing the reference's length-sorted reverse traversal.
+    """
+    b_, t, d = x_btd.shape
+    hidden = w_rec.shape[0]
+    if w_in is None:  # input already projected to 4H (lstmemory contract)
+        gates = x_btd
+    else:
+        gates = _mm(x_btd.reshape(b_ * t, d), w_in).reshape(b_, t, 4 * hidden)
+    if b is not None:
+        gates = gates + b
+    if h0 is None:
+        h0 = jnp.zeros((b_, hidden), x_btd.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b_, hidden), x_btd.dtype)
+    if reverse:
+        # reverse within valid region so step 0 sees the last valid frame
+        from paddle_tpu.core.sequence import SequenceBatch
+
+        sb = SequenceBatch(gates, jnp.sum(mask_bt, axis=1).astype(jnp.int32))
+        gates = sb.reverse().data
+    gates_tm = jnp.swapaxes(gates, 0, 1)
+    mask_tm = jnp.swapaxes(mask_bt, 0, 1)
+    step = partial(lstm_step, w_rec=w_rec, gate_act=gate_act,
+                   state_act=state_act, use_peephole=use_peephole, w_peep=w_peep)
+
+    def body(carry, xs):
+        g_t, m_t = xs
+        return step(carry, g_t, mask_t=m_t)
+
+    (h_f, c_f), ys = lax.scan(body, (h0, c0), (gates_tm, mask_tm))
+    h_seq = jnp.swapaxes(ys, 0, 1)
+    if reverse:
+        from paddle_tpu.core.sequence import SequenceBatch
+
+        sb = SequenceBatch(h_seq, jnp.sum(mask_bt, axis=1).astype(jnp.int32))
+        h_seq = sb.reverse().data
+    return h_seq * mask_bt[..., None], (h_f, c_f)
+
+
+def gru_scan(x_btd, mask_bt, w_in, b, w_rec_rz, w_rec_c, h0=None,
+             gate_act=jax.nn.sigmoid, state_act=jnp.tanh, reverse=False):
+    """Full-sequence GRU; same batching strategy as lstm_scan."""
+    b_, t, d = x_btd.shape
+    hidden = w_rec_c.shape[0]
+    if w_in is None:  # input already projected to 3H (grumemory contract)
+        proj = x_btd
+    else:
+        proj = _mm(x_btd.reshape(b_ * t, d), w_in).reshape(b_, t, 3 * hidden)
+    if b is not None:
+        proj = proj + b
+    if h0 is None:
+        h0 = jnp.zeros((b_, hidden), x_btd.dtype)
+    if reverse:
+        from paddle_tpu.core.sequence import SequenceBatch
+
+        sb = SequenceBatch(proj, jnp.sum(mask_bt, axis=1).astype(jnp.int32))
+        proj = sb.reverse().data
+    proj_tm = jnp.swapaxes(proj, 0, 1)
+    mask_tm = jnp.swapaxes(mask_bt, 0, 1)
+
+    def body(carry, xs):
+        p_t, m_t = xs
+        return gru_step(carry, p_t, w_rec_rz, w_rec_c, m_t, gate_act, state_act)
+
+    h_f, ys = lax.scan(body, h0, (proj_tm, mask_tm))
+    h_seq = jnp.swapaxes(ys, 0, 1)
+    if reverse:
+        from paddle_tpu.core.sequence import SequenceBatch
+
+        sb = SequenceBatch(h_seq, jnp.sum(mask_bt, axis=1).astype(jnp.int32))
+        h_seq = sb.reverse().data
+    return h_seq * mask_bt[..., None], h_f
+
+
+def rnn_scan(x_btd, mask_bt, w_rec, h0=None, act=jnp.tanh, reverse=False):
+    """Vanilla RNN over a precomputed input projection x [B, T, H]
+    (reference: RecurrentLayer — input is already projected by a preceding
+    fc/mixed layer, matching its 'input must equal hidden size' contract)."""
+    b_, t, hidden = x_btd.shape
+    if h0 is None:
+        h0 = jnp.zeros((b_, hidden), x_btd.dtype)
+    inp = x_btd
+    if reverse:
+        from paddle_tpu.core.sequence import SequenceBatch
+
+        sb = SequenceBatch(inp, jnp.sum(mask_bt, axis=1).astype(jnp.int32))
+        inp = sb.reverse().data
+    inp_tm = jnp.swapaxes(inp, 0, 1)
+    mask_tm = jnp.swapaxes(mask_bt, 0, 1)
+
+    def body(carry, xs):
+        i_t, m_t = xs
+        return rnn_step(carry, i_t, w_rec, m_t, act)
+
+    h_f, ys = lax.scan(body, h0, (inp_tm, mask_tm))
+    h_seq = jnp.swapaxes(ys, 0, 1)
+    if reverse:
+        from paddle_tpu.core.sequence import SequenceBatch
+
+        sb = SequenceBatch(h_seq, jnp.sum(mask_bt, axis=1).astype(jnp.int32))
+        h_seq = sb.reverse().data
+    return h_seq * mask_bt[..., None], h_f

@@ -1,0 +1,80 @@
+"""Sequence kernels: context projection, row conv, expand.
+
+Replaces the reference's hl_sequence* CUDA kernels (paddle/cuda/
+hl_sequence.h, hl_cuda_sequence.cu) and function/ContextProjectionOp,
+RowConvOp. On the padded [B, T, D] layout these become shift/mask/matmul
+compositions that XLA fuses; no scatter/gather over start positions.
+"""
+
+import jax.numpy as jnp
+
+
+def shift_steps(data, mask, offset, pad_value=0.0):
+    """Shift a [B, T, D] batch by ``offset`` steps within each sequence:
+    out[:, t] = data[:, t + offset] where valid, else pad_value.
+    Padding regions never leak across sequence boundaries because ``mask``
+    zeroes invalid steps."""
+    if offset == 0:
+        shifted = data
+        valid = mask
+    elif offset > 0:
+        shifted = jnp.concatenate(
+            [data[:, offset:], jnp.zeros_like(data[:, :offset])], axis=1)
+        valid = jnp.concatenate(
+            [mask[:, offset:], jnp.zeros_like(mask[:, :offset])], axis=1)
+    else:
+        k = -offset
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(data[:, :k]), data[:, :-k]], axis=1)
+        valid = jnp.concatenate(
+            [jnp.zeros_like(mask[:, :k]), mask[:, :-k]], axis=1)
+    out = jnp.where(valid[..., None], shifted, pad_value)
+    return out
+
+
+def context_projection(data, mask, context_start, context_len, padding=None):
+    """Concatenate a sliding window of timesteps (reference:
+    ContextProjectionOp / ContextProjection): out[:, t] = concat over
+    o in [start, start+len) of data[:, t+o]. Out-of-sequence steps use
+    zeros, or rows of a trainable ``padding`` [|start| + max(0, start+len-1), D]
+    table when provided (reference's trainable_padding)."""
+    cols = []
+    begin_pad = max(0, -context_start)
+    for i in range(context_len):
+        offset = context_start + i
+        col = shift_steps(data, mask, offset)
+        if padding is not None:
+            if offset < 0:
+                # first |offset| steps of each sequence read padding rows
+                t = jnp.arange(data.shape[1])[None, :, None]
+                pad_row = padding[begin_pad + offset]
+                use_pad = (t < -offset) & mask[..., None]
+                col = jnp.where(use_pad, pad_row, col)
+            elif offset > 0:
+                # last `offset` valid steps read end-padding rows
+                t = jnp.arange(data.shape[1])[None, :, None]
+                lengths = jnp.sum(mask, axis=1).astype(jnp.int32)[:, None, None]
+                pad_row = padding[begin_pad + offset - 1]
+                use_pad = (t >= lengths - offset) & mask[..., None]
+                col = jnp.where(use_pad, pad_row, col)
+        cols.append(col)
+    return jnp.concatenate(cols, axis=-1)
+
+
+def row_conv(data, mask, weights):
+    """Lookahead row convolution (reference: RowConvOp/RowConvLayer):
+    out[:, t] = sum_{i=0..k-1} w[i] * data[:, t+i], masked to sequence."""
+    k = weights.shape[0]
+    out = jnp.zeros_like(data)
+    for i in range(k):
+        out = out + shift_steps(data, mask, i) * weights[i]
+    return out * mask[..., None]
+
+
+def expand_to(data, target_mask):
+    """Broadcast one row per sequence across its timesteps (reference:
+    ExpandLayer): data [B, D] -> [B, T, D] masked by target_mask."""
+    out = jnp.broadcast_to(
+        data[:, None, :], (data.shape[0], target_mask.shape[1], data.shape[-1])
+    )
+    return out * target_mask[..., None].astype(data.dtype)

@@ -1,0 +1,310 @@
+"""Optimizers, learning-rate schedules, regularizers, gradient clipping.
+
+Parity inventory (reference): paddle/parameter/FirstOrderOptimizer.h:23-331 —
+Sgd(+Momentum), Adagrad, AdaDelta, RMSProp, DecayedAdagrad, Adam, Adamax,
+OptimizerWithGradientClipping; Regularizer.h L1/L2; LearningRateScheduler.cpp
+poly/exp/discexp/linear; ModelAverage (AverageOptimizer); v2 surface
+python/paddle/v2/optimizer.py. The standalone C-ABI optimizer library
+(paddle/optimizer, consumed by the Go pserver) has no role here: in the
+pserver-free design the optimizer runs *inside* the jitted train step, sharded
+with the parameters (update math fuses with the backward pass — the TPU
+version of TrainingAlgorithmOp.cu's fused update kernels).
+
+All update rules are pure: ``step(grads, state, params, lr) -> (new_params,
+new_state)``; hyper-schedules are jnp expressions of the global step so the
+whole thing lives under jit.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.utils.error import enforce
+
+
+# ---------------------------------------------------------------------------
+# learning-rate schedules (LearningRateScheduler.cpp parity)
+# ---------------------------------------------------------------------------
+def make_lr_schedule(learning_rate, learning_rate_decay_a=0.0,
+                     learning_rate_decay_b=0.0, learning_rate_schedule="constant"):
+    base = float(learning_rate)
+    a, b = float(learning_rate_decay_a), float(learning_rate_decay_b)
+
+    if learning_rate_schedule == "constant":
+        return lambda step: jnp.asarray(base)
+    if learning_rate_schedule == "poly":
+        # lr * (1 + a*t)^(-b)
+        return lambda step: base * jnp.power(1.0 + a * step, -b)
+    if learning_rate_schedule == "caffe_poly":
+        # lr * (1 - t/a)^b with t clipped to a
+        return lambda step: base * jnp.power(
+            1.0 - jnp.minimum(step, a) / a, b)
+    if learning_rate_schedule == "exp":
+        # lr * a^(t/b)
+        return lambda step: base * jnp.power(a, step / b)
+    if learning_rate_schedule == "discexp":
+        # lr * a^floor(t/b)
+        return lambda step: base * jnp.power(a, jnp.floor(step / b))
+    if learning_rate_schedule == "linear":
+        # max(lr - a*t, b)
+        return lambda step: jnp.maximum(base - a * step, b)
+    raise ValueError("unknown learning_rate_schedule %r" % learning_rate_schedule)
+
+
+# ---------------------------------------------------------------------------
+# base optimizer
+# ---------------------------------------------------------------------------
+class Optimizer:
+    """Base: handles schedules, clipping, L1/L2 decay, model average.
+
+    Per-parameter attributes (lr mult, l1/l2 override, clipping threshold,
+    static) come in via ``param_meta``: {name: ParamAttr-like}.
+    """
+
+    def __init__(self, learning_rate=1e-3, regularization=None,
+                 gradient_clipping_threshold=None, model_average=None,
+                 learning_rate_decay_a=0.0, learning_rate_decay_b=0.0,
+                 learning_rate_schedule="constant"):
+        self.lr_fn = make_lr_schedule(
+            learning_rate, learning_rate_decay_a, learning_rate_decay_b,
+            learning_rate_schedule)
+        self.regularization = regularization
+        self.clip = gradient_clipping_threshold
+        if model_average is not None and not isinstance(model_average, float):
+            model_average = model_average.decay
+        self.model_average = model_average
+
+    # slots ------------------------------------------------------------------
+    def init_slot(self, param):
+        """Per-parameter optimizer slots (a pytree of arrays)."""
+        return ()
+
+    def apply_update(self, grad, slot, param, lr):
+        """Pure per-parameter update; returns (delta, new_slot) where
+        new_param = param + delta."""
+        raise NotImplementedError
+
+    # full-step --------------------------------------------------------------
+    def init_state(self, params):
+        state = {
+            "step": jnp.zeros((), jnp.int32),
+            "slots": {k: self.init_slot(v) for k, v in params.items()},
+        }
+        if self.model_average:
+            state["average"] = {k: jnp.asarray(v) for k, v in params.items()}
+        return state
+
+    def step(self, params, grads, state, param_meta=None):
+        """Apply one update. ``param_meta``: {name: ParamAttr} for per-param
+        lr multipliers / decay overrides / clipping (reference:
+        ParameterConfig fields consumed by FirstOrderOptimizer)."""
+        param_meta = param_meta or {}
+        step_no = state["step"] + 1
+        lr_t = self.lr_fn(step_no.astype(jnp.float32))
+        new_params, new_slots = {}, {}
+        avg = state.get("average")
+        new_avg = {} if avg is not None else None
+        for name, param in params.items():
+            grad = grads[name]
+            attr = param_meta.get(name)
+            lr_mult = getattr(attr, "learning_rate", 1.0) if attr else 1.0
+            clip = (getattr(attr, "gradient_clipping_threshold", None)
+                    if attr else None) or self.clip
+            if clip:
+                norm = jnp.linalg.norm(grad)
+                grad = grad * jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+            l1 = getattr(attr, "l1_rate", None) if attr else None
+            l2 = getattr(attr, "l2_rate", None) if attr else None
+            if self.regularization is not None:
+                l1 = self.regularization.l1 if l1 is None else l1
+                l2 = self.regularization.l2 if l2 is None else l2
+            if l2:
+                grad = grad + l2 * param
+            lr = lr_t * lr_mult
+            delta, new_slot = self.apply_update(grad, state["slots"][name], param, lr)
+            new_param = param + delta
+            if l1:
+                # proximal L1 shrinkage (reference: L1Regularizer::update)
+                new_param = jnp.sign(new_param) * jnp.maximum(
+                    jnp.abs(new_param) - lr * l1, 0.0)
+            new_params[name] = new_param
+            new_slots[name] = new_slot
+            if new_avg is not None:
+                decay = self.model_average
+                new_avg[name] = decay * avg[name] + (1.0 - decay) * new_param
+        new_state = {"step": step_no, "slots": new_slots}
+        if new_avg is not None:
+            new_state["average"] = new_avg
+        return new_params, new_state
+
+
+class Momentum(Optimizer):
+    """SGD with (optionally Nesterov) momentum (reference: SgdOptimizer /
+    SparseMomentumParameterOptimizer; v2 optimizer.Momentum)."""
+
+    def __init__(self, momentum=0.0, sparse=False, nesterov=False, **kw):
+        super().__init__(**kw)
+        self.mu = float(momentum)
+        self.nesterov = nesterov
+
+    def init_slot(self, param):
+        if self.mu == 0.0:
+            return ()
+        return (jnp.zeros_like(param),)
+
+    def apply_update(self, grad, slot, param, lr):
+        if self.mu == 0.0:
+            return -lr * grad, ()
+        (vel,) = slot
+        new_vel = self.mu * vel - lr * grad
+        if self.nesterov:
+            delta = self.mu * new_vel - lr * grad
+        else:
+            delta = new_vel
+        return delta, (new_vel,)
+
+
+SGD = Momentum
+
+
+class Adam(Optimizer):
+    """reference: AdamParameterOptimizer (FirstOrderOptimizer.h:265)."""
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        kw.setdefault("learning_rate", 1e-3)
+        super().__init__(**kw)
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+
+    def init_slot(self, param):
+        return (jnp.zeros_like(param), jnp.zeros_like(param),
+                jnp.zeros((), jnp.int32))
+
+    def apply_update(self, grad, slot, param, lr):
+        m, v, t = slot
+        t = t + 1
+        m = self.b1 * m + (1.0 - self.b1) * grad
+        v = self.b2 * v + (1.0 - self.b2) * grad * grad
+        tf = t.astype(grad.dtype)
+        m_hat = m / (1.0 - jnp.power(self.b1, tf))
+        v_hat = v / (1.0 - jnp.power(self.b2, tf))
+        delta = -lr * m_hat / (jnp.sqrt(v_hat) + self.eps)
+        return delta, (m, v, t)
+
+
+class Adamax(Optimizer):
+    """reference: AdamaxParameterOptimizer (FirstOrderOptimizer.h:303)."""
+
+    def __init__(self, beta1=0.9, beta2=0.999, **kw):
+        kw.setdefault("learning_rate", 2e-3)
+        super().__init__(**kw)
+        self.b1, self.b2 = beta1, beta2
+
+    def init_slot(self, param):
+        return (jnp.zeros_like(param), jnp.zeros_like(param),
+                jnp.zeros((), jnp.int32))
+
+    def apply_update(self, grad, slot, param, lr):
+        m, u, t = slot
+        t = t + 1
+        m = self.b1 * m + (1.0 - self.b1) * grad
+        u = jnp.maximum(self.b2 * u, jnp.abs(grad))
+        tf = t.astype(grad.dtype)
+        delta = -lr / (1.0 - jnp.power(self.b1, tf)) * m / (u + 1e-12)
+        return delta, (m, u, t)
+
+
+class AdaGrad(Optimizer):
+    """reference: AdagradParameterOptimizer (FirstOrderOptimizer.h:near 80)."""
+
+    def __init__(self, epsilon=1e-6, **kw):
+        kw.setdefault("learning_rate", 1e-2)
+        super().__init__(**kw)
+        self.eps = epsilon
+
+    def init_slot(self, param):
+        return (jnp.zeros_like(param),)
+
+    def apply_update(self, grad, slot, param, lr):
+        (accum,) = slot
+        accum = accum + grad * grad
+        delta = -lr * grad / (jnp.sqrt(accum) + self.eps)
+        return delta, (accum,)
+
+
+class DecayedAdaGrad(Optimizer):
+    """reference: DecayedAdagradParameterOptimizer."""
+
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        kw.setdefault("learning_rate", 1e-2)
+        super().__init__(**kw)
+        self.rho, self.eps = rho, epsilon
+
+    def init_slot(self, param):
+        return (jnp.zeros_like(param),)
+
+    def apply_update(self, grad, slot, param, lr):
+        (accum,) = slot
+        accum = self.rho * accum + (1.0 - self.rho) * grad * grad
+        delta = -lr * grad / (jnp.sqrt(accum) + self.eps)
+        return delta, (accum,)
+
+
+class AdaDelta(Optimizer):
+    """reference: AdaDeltaParameterOptimizer."""
+
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        kw.setdefault("learning_rate", 1.0)
+        super().__init__(**kw)
+        self.rho, self.eps = rho, epsilon
+
+    def init_slot(self, param):
+        return (jnp.zeros_like(param), jnp.zeros_like(param))
+
+    def apply_update(self, grad, slot, param, lr):
+        accum_g, accum_x = slot
+        accum_g = self.rho * accum_g + (1.0 - self.rho) * grad * grad
+        update = -(jnp.sqrt(accum_x + self.eps) /
+                   jnp.sqrt(accum_g + self.eps)) * grad
+        accum_x = self.rho * accum_x + (1.0 - self.rho) * update * update
+        return lr * update, (accum_g, accum_x)
+
+
+class RMSProp(Optimizer):
+    """reference: RMSPropParameterOptimizer (with mean-subtracted variant)."""
+
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        kw.setdefault("learning_rate", 1e-3)
+        super().__init__(**kw)
+        self.rho, self.eps = rho, epsilon
+
+    def init_slot(self, param):
+        return (jnp.zeros_like(param), jnp.zeros_like(param))
+
+    def apply_update(self, grad, slot, param, lr):
+        accum, mean = slot
+        accum = self.rho * accum + (1.0 - self.rho) * grad * grad
+        mean = self.rho * mean + (1.0 - self.rho) * grad
+        delta = -lr * grad / jnp.sqrt(accum - mean * mean + self.eps)
+        return delta, (accum, mean)
+
+
+class L2Regularization:
+    def __init__(self, rate=0.0):
+        self.l1, self.l2 = 0.0, float(rate)
+
+
+class L1Regularization:
+    def __init__(self, rate=0.0):
+        self.l1, self.l2 = float(rate), 0.0
+
+
+class Regularization:
+    def __init__(self, l1=0.0, l2=0.0):
+        self.l1, self.l2 = float(l1), float(l2)
+
+
+class ModelAverage:
+    """Exponential parameter averaging (reference: AverageOptimizer /
+    ModelAverage in v2 optimizer settings)."""
+
+    def __init__(self, average_window=0.999):
+        self.decay = float(average_window)

@@ -1,0 +1,16 @@
+"""Parallelism over device meshes.
+
+TPU-native replacement for the reference's entire distribution stack
+(SURVEY.md §2.4): MultiGradientMachine intra-node DP, the C++ pserver
+(ParameterServer2/ParameterClient2 RPC), and the Go pserver. Gradient
+exchange collapses into XLA collectives over ICI inside one pjit-ed train
+step; optimizer state can be sharded ZeRO-style; embedding tables shard over
+a model axis (sparse/EP parity).
+"""
+
+from paddle_tpu.parallel.mesh import (
+    DataParallel,
+    build_mesh,
+    local_device_count,
+)
+from paddle_tpu.parallel import sharded_embedding

@@ -1,0 +1,65 @@
+"""Sequence pooling types (cf. trainer_config_helpers/poolings.py:
+MaxPooling, AvgPooling, SumPooling, SqrtAvgPooling used by pooling_layer
+over variable-length sequences; C++ side SequencePoolLayer family)."""
+
+import jax.numpy as jnp
+
+
+class BasePoolingType:
+    name = None
+
+
+class MaxPooling(BasePoolingType):
+    name = "max"
+
+    @staticmethod
+    def reduce(data, mask):
+        neg = jnp.finfo(data.dtype).min
+        masked = jnp.where(mask[..., None], data, neg)
+        out = jnp.max(masked, axis=1)
+        # all-empty sequences pool to 0 like the reference's empty handling
+        any_valid = jnp.any(mask, axis=1)[..., None]
+        return jnp.where(any_valid, out, 0.0)
+
+
+class AvgPooling(BasePoolingType):
+    name = "average"
+
+    @staticmethod
+    def reduce(data, mask):
+        m = mask[..., None].astype(data.dtype)
+        total = jnp.sum(data * m, axis=1)
+        count = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        return total / count
+
+
+class SumPooling(BasePoolingType):
+    name = "sum"
+
+    @staticmethod
+    def reduce(data, mask):
+        m = mask[..., None].astype(data.dtype)
+        return jnp.sum(data * m, axis=1)
+
+
+class SqrtAvgPooling(BasePoolingType):
+    """sum / sqrt(len) scaling (cf. AverageLayer 'sqrt' strategy)."""
+
+    name = "sqrt_average"
+
+    @staticmethod
+    def reduce(data, mask):
+        m = mask[..., None].astype(data.dtype)
+        total = jnp.sum(data * m, axis=1)
+        count = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        return total / jnp.sqrt(count)
+
+
+def to_pooling(pool):
+    if pool is None:
+        return MaxPooling()
+    if isinstance(pool, BasePoolingType):
+        return pool
+    if isinstance(pool, type) and issubclass(pool, BasePoolingType):
+        return pool()
+    raise TypeError("cannot convert %r to pooling type" % (pool,))

@@ -1,0 +1,19 @@
+"""Reader creators and decorators (parity: python/paddle/v2/reader/
+decorator.py:26-220 — map_readers, buffered, compose, chain, shuffle,
+firstn, cache; plus xmap_readers thread pool)."""
+
+from paddle_tpu.reader.decorator import (
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    shuffle,
+    xmap_readers,
+)
+
+__all__ = [
+    "buffered", "cache", "chain", "compose", "firstn", "map_readers",
+    "shuffle", "xmap_readers",
+]

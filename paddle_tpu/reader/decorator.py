@@ -1,0 +1,206 @@
+"""Functional reader transformers.
+
+Parity with python/paddle/v2/reader/decorator.py (map_readers :26,
+shuffle :64, chain :90, compose :130, buffered :180, firstn :205,
+xmap_readers). A reader is a zero-arg callable returning an iterable of
+samples. ``buffered``/``xmap_readers`` provide the background-thread
+overlap that PyDataProvider2's pool thread gave the reference
+(gserver/dataproviders/PyDataProvider2.cpp:334).
+"""
+
+import itertools
+import queue
+import random as _random
+import threading
+
+
+def map_readers(func, *readers):
+    """Element-wise map over zipped readers."""
+
+    def reader():
+        iters = [r() for r in readers]
+        for items in zip(*iters):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size, seed=None):
+    """Pool-based shuffle (same windowed semantics as the reference)."""
+
+    def shuffled():
+        rng = _random.Random(seed)
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                for s in buf:
+                    yield s
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            for s in buf:
+                yield s
+
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers."""
+
+    def reader():
+        for r in readers:
+            for sample in r():
+                yield sample
+
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    """Zip readers into combined tuples."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    _end = object()
+
+    def reader():
+        iters = [r() for r in readers]
+        if check_alignment:
+            # sentinel-based zip: any reader ending while another still has
+            # items is a mismatch, even off-by-one (plain zip would consume
+            # and drop the extra sample before noticing)
+            while True:
+                items = [next(it, _end) for it in iters]
+                ended = [i is _end for i in items]
+                if all(ended):
+                    return
+                if any(ended):
+                    raise ValueError("readers of compose have different lengths")
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in itertools.zip_longest(*iters, fillvalue=_end):
+                yield sum((make_tuple(i) for i in items if i is not _end), ())
+
+    return reader
+
+
+class _End:
+    pass
+
+
+def buffered(reader, size):
+    """Background-thread prefetch buffer (reference: buffered :180 — the
+    data-provider pool-thread overlap)."""
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+        err = []
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            except BaseException as e:  # surfaced in consumer
+                err.append(e)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            sample = q.get()
+            if sample is _End:
+                if err:
+                    raise err[0]
+                return
+            yield sample
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, sample in enumerate(reader()):
+            if i >= n:
+                return
+            yield sample
+
+    return firstn_reader
+
+
+def cache(reader):
+    """Materialize once, replay thereafter (reference: per-pass RAM cache,
+    PyDataProvider2 CacheType.CACHE_PASS_IN_MEM)."""
+    state = {"data": None}
+
+    def cached_reader():
+        if state["data"] is None:
+            # fill into a local list and publish only on a *completed* pass,
+            # so an abandoned first iteration can't duplicate samples
+            fill = []
+            for sample in reader():
+                fill.append(sample)
+                yield sample
+            state["data"] = fill
+        else:
+            for sample in state["data"]:
+                yield sample
+
+    return cached_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map with worker threads (reference: xmap_readers)."""
+
+    def xreader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+        err = []
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    return
+                i, sample = item
+                try:
+                    out_q.put((i, mapper(sample)))
+                except BaseException as e:
+                    err.append(e)
+                    out_q.put(_End)
+                    return
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is _End:
+                finished += 1
+                if err:
+                    raise err[0]
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        while order and next_idx in pending:
+            yield pending.pop(next_idx)
+            next_idx += 1
+
+    return xreader

@@ -1,0 +1,180 @@
+"""Topology: the compiled view of a layer DAG.
+
+Parity with python/paddle/v2/topology.py (which serialized the cost subgraph
+to a ModelConfig proto) and with the C++ NeuralNetwork executor
+(gserver/gradientmachines/NeuralNetwork.cpp:235): here the "executor" is just
+a Python loop over topologically-sorted nodes executed *inside a jax trace*,
+so the runtime artifact is a single fused XLA program, not a per-layer
+interpreter.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
+from paddle_tpu.data_type import DENSE, INDEX, SEQ_NESTED, SEQ_NONE, SEQ_SINGLE, SPARSE_BINARY, SPARSE_FLOAT
+from paddle_tpu.graph import Context, LayerNode, topo_sort
+from paddle_tpu.utils.error import enforce
+
+
+class Topology:
+    def __init__(self, outputs):
+        if isinstance(outputs, LayerNode):
+            outputs = [outputs]
+        self.outputs = list(outputs)
+        self.nodes = topo_sort(self.outputs)
+        self.by_name = {}
+        for node in self.nodes:
+            enforce(node.name not in self.by_name, "duplicate layer name %r", node.name)
+            self.by_name[node.name] = node
+        self.data_layers = {
+            n.name: n for n in self.nodes if n.layer_type == "data"
+        }
+
+    # -- parameters ---------------------------------------------------------
+    def param_specs(self):
+        """Merged specs keyed by parameter name; shared params must agree."""
+        merged = {}
+        for node in self.nodes:
+            for spec in node.param_specs:
+                prev = merged.get(spec.name)
+                if prev is None:
+                    merged[spec.name] = spec
+                else:
+                    enforce(
+                        prev.shape == spec.shape,
+                        "shared parameter %r shape mismatch: %s vs %s",
+                        spec.name,
+                        prev.shape,
+                        spec.shape,
+                    )
+        return merged
+
+    def init_params(self, rng=None, dtype=None):
+        """Materialize all parameters (cf. Parameter::randomize +
+        parameters.create, python/paddle/v2/parameters.py)."""
+        if rng is None:
+            from paddle_tpu.utils import flags
+
+            rng = jax.random.PRNGKey(flags.get_flag("seed") or 0)
+        dtype = dtype_mod.canonical(dtype)
+        out = {}
+        for i, (name, spec) in enumerate(sorted(self.param_specs().items())):
+            out[name] = spec.materialize(jax.random.fold_in(rng, i), dtype)
+        return out
+
+    # -- evaluation ---------------------------------------------------------
+    def apply(self, params, feed, mode="train", rng=None, outputs=None):
+        """Evaluate the DAG. Returns ({layer_name: value}, state_updates).
+
+        ``feed`` maps data-layer names to already-converted device values
+        (see :func:`convert_feed`); ``outputs`` optionally restricts which
+        layers' values are returned (all output nodes by default).
+        """
+        ctx = Context(mode=mode, rng=rng)
+        values = {}
+        for node in self.nodes:
+            if node.layer_type == "data":
+                enforce(node.name in feed, "missing feed for data layer %r", node.name)
+                values[node.name] = node.forward(params, [feed[node.name]], ctx)
+            else:
+                inputs = [values[p.name] for p in node.inputs]
+                values[node.name] = node.forward(params, inputs, ctx)
+        wanted = outputs or [o.name for o in self.outputs]
+        return {name: values[name] for name in wanted}, ctx.state_updates
+
+    def apply_all(self, params, feed, mode="test", rng=None):
+        """Like apply() but returns every layer's value (debug / tests /
+        --show_layer_stat parity)."""
+        ctx = Context(mode=mode, rng=rng)
+        values = {}
+        for node in self.nodes:
+            if node.layer_type == "data":
+                values[node.name] = node.forward(params, [feed[node.name]], ctx)
+            else:
+                inputs = [values[p.name] for p in node.inputs]
+                values[node.name] = node.forward(params, inputs, ctx)
+        return values, ctx.state_updates
+
+    def data_types(self):
+        """[(name, InputType)] for feeder construction (v2 Topology.data_type)."""
+        return [
+            (name, node.input_type)
+            for name, node in sorted(self.data_layers.items())
+            if getattr(node, "input_type", None) is not None
+        ]
+
+
+def convert_feed(topology, data_batch, feeding=None):
+    """Convert a host minibatch (list of tuples, v2 reader convention) into
+    device-ready feed values according to each data layer's InputType.
+
+    Parity with py_paddle DataProviderConverter (reference:
+    paddle/py_paddle/dataprovider_converter.py): dense slots become [B, dim]
+    arrays, index slots int32 [B], sequence slots SequenceBatch, nested
+    slots NestedSequenceBatch, sparse slots are densified (TPU path keeps
+    embeddings dense-gathered; true sparse storage lives in the sparse
+    embedding subsystem).
+    """
+    names = [name for name, _ in topology.data_types()]
+    if feeding is None:
+        feeding = {name: i for i, name in enumerate(names)}
+    feed = {}
+    for name, itype in topology.data_types():
+        idx = feeding[name]
+        for row in data_batch:
+            enforce(
+                idx < len(row),
+                "sample tuple of length %d has no column %d for data layer %r "
+                "(feeding=%r)", len(row), idx, name, feeding)
+        col = [row[idx] for row in data_batch]
+        feed[name] = convert_column(col, itype)
+    return feed
+
+
+def convert_column(col, itype):
+    if itype.seq_type == SEQ_NONE:
+        if itype.value_type == DENSE:
+            return jnp.asarray(np.asarray(col, dtype=np.float32))
+        if itype.value_type == INDEX:
+            return jnp.asarray(np.asarray(col, dtype=np.int32))
+        if itype.value_type in (SPARSE_BINARY, SPARSE_FLOAT):
+            return jnp.asarray(_densify(col, itype))
+    elif itype.seq_type == SEQ_SINGLE:
+        if itype.value_type == DENSE:
+            seqs = [np.asarray(s, dtype=np.float32) for s in col]
+        elif itype.value_type == INDEX:
+            seqs = [np.asarray(s, dtype=np.int32) for s in col]
+        else:
+            seqs = [_densify(s, itype) for s in col]
+        return SequenceBatch.from_sequences(seqs)
+    elif itype.seq_type == SEQ_NESTED:
+        if itype.value_type == DENSE:
+            nested = [[np.asarray(s, dtype=np.float32) for s in subs] for subs in col]
+        elif itype.value_type == INDEX:
+            nested = [[np.asarray(s, dtype=np.int32) for s in subs] for subs in col]
+        else:
+            nested = [[_densify(s, itype) for s in subs] for subs in col]
+        return NestedSequenceBatch.from_nested(nested)
+    raise TypeError("unsupported input type %r" % (itype,))
+
+
+def _densify(rows, itype):
+    """sparse ids / (id, value) pairs -> dense float32 rows."""
+    if isinstance(rows, np.ndarray) and rows.ndim == 2:
+        return rows.astype(np.float32)
+    first = rows[0] if len(rows) else None
+    is_batch = isinstance(first, (list, tuple, np.ndarray))
+    batch = rows if is_batch else [rows]
+    out = np.zeros((len(batch), itype.dim), dtype=np.float32)
+    for i, row in enumerate(batch):
+        for item in row:
+            if isinstance(item, (tuple, list)):
+                idx, val = item
+                out[i, int(idx)] = float(val)
+            else:
+                out[i, int(item)] = 1.0
+    return out if is_batch else out[0]

@@ -1,0 +1,210 @@
+"""The SGD trainer: event-driven training loop around one jitted train step.
+
+Parity with python/paddle/v2/trainer.py (SGD.train :106-176 event loop,
+test :178) and the C++ hot loop TrainerInternal::trainOneBatch
+(paddle/trainer/TrainerInternal.cpp:66-140). The reference's per-batch
+sequence — startBatch → forwardBackward (layer loop) → per-parameter
+updateCallback → finishBatch — collapses into ONE XLA program here:
+forward + backward (jax.grad) + optimizer update + BN-state update + metric
+stats, compiled once and reused every batch. GradientMachine has no separate
+existence: the topology IS the gradient machine.
+
+Data parallelism: pass ``parallelism=paddle_tpu.parallel.DataParallel(...)``
+to shard the batch over a device mesh — the train step is then pjit-ed with
+batch-sharded inputs and replicated (or ZeRO-sharded) parameters, replacing
+MultiGradientMachine and the pserver path (SURVEY.md §2.4).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import event as v2_event
+from paddle_tpu.graph import LayerNode
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.topology import Topology, convert_feed
+from paddle_tpu.utils import flags
+from paddle_tpu.utils.error import enforce
+from paddle_tpu.utils.logger import logger
+from paddle_tpu.utils.stat import global_stats
+
+
+class SGD:
+    """v2-API trainer. ``update_equation`` is a paddle_tpu.optimizer.Optimizer."""
+
+    def __init__(self, cost, parameters, update_equation, extra_layers=None,
+                 is_local=True, feeding=None, parallelism=None):
+        from paddle_tpu.optimizer import Optimizer
+
+        enforce(isinstance(parameters, Parameters),
+                "parameters must be a Parameters object")
+        enforce(isinstance(update_equation, Optimizer),
+                "update_equation must be an Optimizer")
+        self.costs = [cost] if isinstance(cost, LayerNode) else list(cost)
+        extra = [e for e in (extra_layers or [])]
+        self.evaluators = [e for e in extra if getattr(e, "is_evaluator", False)]
+        self.extra_outputs = [e for e in extra if not getattr(e, "is_evaluator", False)]
+        self.topology = Topology(self.costs + self.evaluators + self.extra_outputs)
+        self.parameters = parameters
+        self.optimizer = update_equation
+        self.feeding = feeding
+        self.parallelism = parallelism
+        self.__prepare__()
+
+    def __prepare__(self):
+        trainable_names, static_names, state_names = self.parameters.partition()
+        self._trainable_names = trainable_names
+        self._static_names = static_names
+        self._state_names = state_names
+        specs = {n: self.parameters.spec(n) for n in self.parameters.names()}
+        self._param_meta = {
+            n: s.attr for n, s in specs.items() if s is not None and not s.is_state
+        }
+        cost_names = [c.name for c in self.costs]
+        eval_nodes = self.evaluators
+
+        topo = self.topology
+        optimizer = self.optimizer
+        param_meta = self._param_meta
+
+        def split(params):
+            t = {n: params[n] for n in trainable_names}
+            s = {n: params[n] for n in static_names}
+            st = {n: params[n] for n in state_names}
+            return t, s, st
+
+        self._split = split
+
+        def forward_all(params, feed, mode, rng):
+            wanted = cost_names + [e.name for e in eval_nodes] \
+                + [o.name for o in self.extra_outputs]
+            values, updates = topo.apply(params, feed, mode=mode, rng=rng,
+                                         outputs=wanted)
+            cost_total = sum(jnp.mean(values[c]) for c in cost_names)
+            eval_stats = {e.name: values[e.name] for e in eval_nodes}
+            return cost_total, values, updates, eval_stats
+
+        def train_step(trainable, static, state, opt_state, feed, rng):
+            def loss_fn(tr):
+                params = {**tr, **static, **state}
+                cost_total, values, updates, eval_stats = forward_all(
+                    params, feed, "train", rng)
+                return cost_total, (updates, eval_stats)
+
+            (loss, (updates, eval_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(trainable)
+            new_trainable, new_opt_state = optimizer.step(
+                trainable, grads, opt_state, param_meta)
+            new_state = {**state, **updates}
+            return loss, new_trainable, new_state, new_opt_state, eval_stats
+
+        def eval_step(trainable, static, state, feed):
+            params = {**trainable, **static, **state}
+            cost_total, values, _, eval_stats = forward_all(
+                params, feed, "test", None)
+            outs = {o.name: values[o.name] for o in self.extra_outputs}
+            return cost_total, eval_stats, outs
+
+        if self.parallelism is not None:
+            self._train_step = self.parallelism.shard_train_step(
+                train_step, self)
+            self._eval_step = self.parallelism.shard_eval_step(eval_step, self)
+        else:
+            self._train_step = jax.jit(train_step, donate_argnums=(0, 2, 3))
+            self._eval_step = jax.jit(eval_step)
+
+        # device-resident training state
+        t, s, st = split(self.parameters.as_dict())
+        self._trainable = {k: jnp.asarray(v) for k, v in t.items()}
+        self._static = {k: jnp.asarray(v) for k, v in s.items()}
+        self._state = {k: jnp.asarray(v) for k, v in st.items()}
+        self._opt_state = optimizer.init_state(self._trainable)
+        self._rng = jax.random.PRNGKey(flags.get_flag("seed") or 0)
+        self._step_count = 0
+
+    # -- main loop ----------------------------------------------------------
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None,
+              sync_params=True):
+        """Event-driven training (v2 SGD.train parity). ``reader`` yields
+        minibatches (lists of sample tuples)."""
+        if event_handler is None:
+            event_handler = default_event_handler
+        feeding = feeding or self.feeding
+        log_period = flags.get_flag("log_period")
+
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            eval_acc = {e.name: None for e in self.evaluators}
+            batch_id = 0
+            for data_batch in reader():
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                with global_stats.timer("feed"):
+                    feed = convert_feed(self.topology, data_batch, feeding)
+                self._rng, step_rng = jax.random.split(self._rng)
+                with global_stats.timer("train_step"):
+                    (loss, self._trainable, self._state, self._opt_state,
+                     stats) = self._train_step(
+                        self._trainable, self._static, self._state,
+                        self._opt_state, feed, step_rng)
+                self._step_count += 1
+                metrics = {}
+                for e in self.evaluators:
+                    eval_acc[e.name] = e.merge(eval_acc[e.name],
+                                               jax.device_get(stats[e.name]))
+                    metrics[e.name] = e.result(eval_acc[e.name])
+                if log_period and batch_id % log_period == 0:
+                    logger.info("pass %d batch %d cost=%.6f %s", pass_id,
+                                batch_id, float(loss), _fmt_metrics(metrics))
+                event_handler(v2_event.EndIteration(
+                    pass_id, batch_id, float(loss), metrics))
+                batch_id += 1
+            if sync_params:
+                self._sync_back()
+            event_handler(v2_event.EndPass(
+                pass_id,
+                {e.name: e.result(eval_acc[e.name]) for e in self.evaluators},
+                gm=self))
+        if sync_params:
+            self._sync_back()
+
+    def test(self, reader, feeding=None):
+        """One evaluation pass; returns a TestResult event (v2 SGD.test)."""
+        feeding = feeding or self.feeding
+        eval_acc = {e.name: None for e in self.evaluators}
+        total_cost, n_batches = 0.0, 0
+        for data_batch in reader():
+            feed = convert_feed(self.topology, data_batch, feeding)
+            cost, stats, _ = self._eval_step(
+                self._trainable, self._static, self._state, feed)
+            total_cost += float(cost)
+            n_batches += 1
+            for e in self.evaluators:
+                eval_acc[e.name] = e.merge(eval_acc[e.name],
+                                           jax.device_get(stats[e.name]))
+        metrics = {e.name: e.result(eval_acc[e.name]) for e in self.evaluators}
+        return v2_event.TestResult(
+            0, total_cost / max(n_batches, 1), metrics)
+
+    # -- state sync ---------------------------------------------------------
+    def _sync_back(self):
+        """Copy device training state back into the Parameters object so
+        save/inspect sees current values (v2's gm<->parameters append)."""
+        host = jax.device_get({**self._trainable, **self._state})
+        self.parameters.update_from(host)
+
+    def save_parameter_to_tar(self, f):
+        self._sync_back()
+        self.parameters.to_tar(f)
+
+
+def default_event_handler(evt):
+    pass
+
+
+def _fmt_metrics(metrics):
+    parts = []
+    for key, val in metrics.items():
+        if isinstance(val, float):
+            parts.append("%s=%.5f" % (key, val))
+    return " ".join(parts)

@@ -7,7 +7,32 @@ path; bench.py runs on the real chip). Must set XLA flags before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Drop the axon TPU-tunnel registration (sitecustomize registers the axon
+# PJRT plugin when this var is set; tests must stay CPU-only and must not
+# touch — or hang on — the single real chip's tunnel).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _strip_accel_backends():
+    """Deregister non-CPU PJRT backends registered by sitecustomize before
+    this conftest ran, so no test can trigger a (possibly hung) tunnel init."""
+    try:
+        import jax
+        from jax._src import xla_bridge as xb
+
+        # sitecustomize may have imported jax already with
+        # JAX_PLATFORMS=axon baked in; force the live config to cpu.
+        jax.config.update("jax_platforms", "cpu")
+        for name in list(xb._backend_factories):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+        xb.backends.cache_clear() if hasattr(xb.backends, "cache_clear") else None
+    except Exception:
+        pass
+
+
+_strip_accel_backends()
 _flag = "--xla_force_host_platform_device_count=8"
 if _flag not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()

@@ -1,0 +1,173 @@
+"""Optimizer update-rule tests (reference pattern:
+paddle/math/tests/test_TrainingAlgorithm.cpp checks each optimizer against
+OriginalOptimizerApi.h reference implementations; here each rule is checked
+against a hand-written numpy step)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu import optimizer as opt
+
+
+def _one_param():
+    rng = np.random.RandomState(0)
+    p = {"w": jnp.asarray(rng.randn(4, 3), jnp.float32)}
+    g = {"w": jnp.asarray(rng.randn(4, 3), jnp.float32)}
+    return p, g
+
+
+def _run(o, p, g, steps=3):
+    state = o.init_state(p)
+    for _ in range(steps):
+        p, state = o.step(p, g, state)
+    return p, state
+
+
+def test_sgd_matches_numpy():
+    p, g = _one_param()
+    out, _ = _run(opt.Momentum(learning_rate=0.1, momentum=0.0), p, g, steps=1)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.asarray(p["w"]) - 0.1 * np.asarray(g["w"]),
+                               rtol=1e-6)
+
+
+def test_momentum_matches_numpy():
+    p, g = _one_param()
+    out, _ = _run(opt.Momentum(learning_rate=0.1, momentum=0.9), p, g, steps=2)
+    pw, gw = np.asarray(p["w"]), np.asarray(g["w"])
+    vel = -0.1 * gw
+    w1 = pw + vel
+    vel = 0.9 * vel - 0.1 * gw
+    w2 = w1 + vel
+    np.testing.assert_allclose(np.asarray(out["w"]), w2, rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    p, g = _one_param()
+    out, _ = _run(opt.Adam(learning_rate=0.01), p, g, steps=1)
+    pw, gw = np.asarray(p["w"]), np.asarray(g["w"])
+    m = 0.1 * gw
+    v = 0.001 * gw * gw
+    mh = m / (1 - 0.9)
+    vh = v / (1 - 0.999)
+    expect = pw - 0.01 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(np.asarray(out["w"]), expect, rtol=1e-5)
+
+
+def test_adagrad_accumulates():
+    p, g = _one_param()
+    out, state = _run(opt.AdaGrad(learning_rate=0.1), p, g, steps=2)
+    accum = np.asarray(state["slots"]["w"][0])
+    np.testing.assert_allclose(accum, 2 * np.asarray(g["w"]) ** 2, rtol=1e-6)
+
+
+@pytest.mark.parametrize("cls", [opt.AdaDelta, opt.RMSProp, opt.DecayedAdaGrad,
+                                 opt.Adamax])
+def test_optimizers_decrease_quadratic(cls):
+    # minimize ||w||^2 — every optimizer should reduce it
+    w = {"w": jnp.asarray(np.ones((8,)), jnp.float32)}
+    o = cls()
+    state = o.init_state(w)
+    start = float(jnp.sum(w["w"] ** 2))
+    for _ in range(300):
+        g = {"w": 2.0 * w["w"]}
+        w, state = o.step(w, g, state)
+    assert float(jnp.sum(w["w"] ** 2)) < start * 0.5
+
+
+def test_l2_regularization_shrinks():
+    p = {"w": jnp.asarray(np.ones((4,)), jnp.float32)}
+    g = {"w": jnp.zeros((4,), jnp.float32)}
+    o = opt.Momentum(learning_rate=0.1,
+                     regularization=opt.L2Regularization(rate=0.5))
+    out, _ = _run(o, p, g, steps=1)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.95 * np.ones(4), rtol=1e-6)
+
+
+def test_l1_proximal_sparsifies():
+    p = {"w": jnp.asarray([0.001, -0.001, 1.0, -1.0], jnp.float32)}
+    g = {"w": jnp.zeros((4,), jnp.float32)}
+    o = opt.Momentum(learning_rate=0.1,
+                     regularization=opt.Regularization(l1=0.05))
+    out, _ = _run(o, p, g, steps=1)
+    w = np.asarray(out["w"])
+    assert w[0] == 0.0 and w[1] == 0.0
+    assert abs(w[2]) < 1.0 and abs(w[3]) < 1.0
+
+
+def test_gradient_clipping():
+    p = {"w": jnp.zeros((3,), jnp.float32)}
+    g = {"w": jnp.asarray([30.0, 40.0, 0.0], jnp.float32)}  # norm 50
+    o = opt.Momentum(learning_rate=1.0, gradient_clipping_threshold=5.0)
+    out, _ = _run(o, p, g, steps=1)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out["w"])), 5.0, rtol=1e-5)
+
+
+def test_lr_schedules():
+    for sched, args, step, expect in [
+        ("poly", dict(learning_rate_decay_a=1.0, learning_rate_decay_b=1.0),
+         9.0, 0.1 * (1 + 9) ** -1),
+        ("exp", dict(learning_rate_decay_a=0.5, learning_rate_decay_b=10.0),
+         10.0, 0.1 * 0.5),
+        ("discexp", dict(learning_rate_decay_a=0.5, learning_rate_decay_b=10.0),
+         15.0, 0.1 * 0.5),
+        ("linear", dict(learning_rate_decay_a=0.01, learning_rate_decay_b=0.05),
+         3.0, 0.1 - 0.03),
+    ]:
+        fn = opt.make_lr_schedule(0.1, learning_rate_schedule=sched, **args)
+        np.testing.assert_allclose(float(fn(jnp.asarray(step))), expect, rtol=1e-6)
+
+
+def test_per_param_lr_multiplier():
+    from paddle_tpu.attr import ParamAttr
+
+    p = {"a": jnp.ones((2,), jnp.float32), "b": jnp.ones((2,), jnp.float32)}
+    g = {"a": jnp.ones((2,), jnp.float32), "b": jnp.ones((2,), jnp.float32)}
+    o = opt.Momentum(learning_rate=0.1)
+    state = o.init_state(p)
+    meta = {"a": ParamAttr(learning_rate=2.0), "b": ParamAttr(learning_rate=0.0)}
+    out, _ = o.step(p, g, state, meta)
+    np.testing.assert_allclose(np.asarray(out["a"]), 0.8 * np.ones(2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["b"]), np.ones(2), rtol=1e-6)
+
+
+def test_model_average():
+    p = {"w": jnp.zeros((2,), jnp.float32)}
+    g = {"w": -jnp.ones((2,), jnp.float32)}
+    o = opt.Momentum(learning_rate=1.0, model_average=opt.ModelAverage(0.5))
+    out, state = _run(o, p, g, steps=3)
+    assert "average" in state
+    avg = np.asarray(state["average"]["w"])
+    # params went 1, 2, 3; avg = 0.5^3*0 + ... = 0.5*(0.5*(0.5*0+0.5*1)+0.5*2)+0.5*3
+    np.testing.assert_allclose(avg, np.full(2, 0.5 * (0.5 * 0.5 + 1.0) + 1.5),
+                               rtol=1e-5)
+
+
+def test_softmax_input_classification_cost_equals_logits_path():
+    """classification_cost on a Softmax-activated layer must equal the
+    logits-path CE (regression: double-softmax bug)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import layer as L, data_type as dtp, activation as A
+    from paddle_tpu.topology import Topology
+    from paddle_tpu.graph import reset_name_counters
+
+    x = L.data(name="cx", type=dtp.dense_vector(5))
+    lab = L.data(name="cy", type=dtp.integer_value(4))
+    from paddle_tpu.attr import ParamAttr
+
+    shared = dict(param_attr=ParamAttr(name="ccw"), bias_attr=False)
+    soft = L.fc(input=x, size=4, act=A.Softmax(), **shared)
+    logit = L.fc(input=x, size=4, act=None, **shared)
+    c1 = L.classification_cost(input=soft, label=lab)
+    c2 = L.classification_cost(input=logit, label=lab)
+    topo = Topology([c1, c2])
+    params = topo.init_params(jax.random.PRNGKey(0))
+    rngnp = np.random.RandomState(0)
+    feed = {"cx": jnp.asarray(rngnp.randn(6, 5), jnp.float32),
+            "cy": jnp.asarray(rngnp.randint(0, 4, 6), jnp.int32)}
+    vals, _ = topo.apply(params, feed, mode="test")
+    np.testing.assert_allclose(np.asarray(vals[c1.name]),
+                               np.asarray(vals[c2.name]), rtol=1e-4)

@@ -1,0 +1,111 @@
+"""Reader decorator tests (reference: python/paddle/v2/reader/tests)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import minibatch, reader as rd
+
+
+def _range_reader(n):
+    def reader():
+        for i in range(n):
+            yield i
+
+    return reader
+
+
+def test_map_readers():
+    out = list(rd.map_readers(lambda a, b: a + b, _range_reader(3),
+                              _range_reader(3))())
+    assert out == [0, 2, 4]
+
+
+def test_shuffle_preserves_elements():
+    out = list(rd.shuffle(_range_reader(20), 5, seed=1)())
+    assert sorted(out) == list(range(20))
+    assert out != list(range(20))
+
+
+def test_chain():
+    out = list(rd.chain(_range_reader(2), _range_reader(3))())
+    assert out == [0, 1, 0, 1, 2]
+
+
+def test_compose():
+    out = list(rd.compose(_range_reader(3), _range_reader(3))())
+    assert out == [(0, 0), (1, 1), (2, 2)]
+
+
+def test_buffered():
+    out = list(rd.buffered(_range_reader(10), 4)())
+    assert out == list(range(10))
+
+
+def test_buffered_propagates_errors():
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        list(rd.buffered(lambda: bad(), 2)())
+
+
+def test_firstn_cache():
+    out = list(rd.firstn(_range_reader(10), 3)())
+    assert out == [0, 1, 2]
+    cached = rd.cache(_range_reader(5))
+    assert list(cached()) == list(range(5))
+    assert list(cached()) == list(range(5))
+
+
+def test_xmap_ordered():
+    out = list(rd.xmap_readers(lambda x: x * 2, _range_reader(20), 4, 8,
+                               order=True)())
+    assert out == [2 * i for i in range(20)]
+
+
+def test_xmap_unordered():
+    out = list(rd.xmap_readers(lambda x: x * 2, _range_reader(20), 4, 8)())
+    assert sorted(out) == [2 * i for i in range(20)]
+
+
+def test_batch():
+    out = list(minibatch.batch(_range_reader(7), 3)())
+    assert out == [[0, 1, 2], [3, 4, 5]]
+    out = list(minibatch.batch(_range_reader(7), 3, drop_last=False)())
+    assert out[-1] == [6]
+
+
+def test_datasets_schemas():
+    from paddle_tpu.dataset import cifar, conll05, imdb, mnist, movielens, \
+        mq2007, uci_housing, wmt14
+
+    img, lab = next(mnist.train()())
+    assert img.shape == (784,) and 0 <= lab < 10
+    img, lab = next(cifar.train10()())
+    assert img.shape == (3072,)
+    x, y = next(uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    ids, lab = next(imdb.train()())
+    assert ids.ndim == 1 and lab in (0, 1)
+    words, labels = next(conll05.train()())
+    assert len(words) == len(labels)
+    src, t_in, t_out = next(wmt14.train()())
+    assert len(t_in) == len(t_out)
+    sample = next(movielens.train()())
+    assert len(sample) == 8
+    a, b, lab = next(mq2007.train()())
+    assert a.shape == (46,) and b.shape == (46,)
+
+
+def test_compose_off_by_one_mismatch():
+    with pytest.raises(ValueError, match="different lengths"):
+        list(rd.compose(_range_reader(2), _range_reader(1))())
+
+
+def test_cache_abandoned_first_pass_no_duplicates():
+    cached = rd.cache(_range_reader(5))
+    it = cached()
+    next(it); next(it)  # abandon mid-pass
+    assert list(cached()) == list(range(5))
+    assert list(cached()) == list(range(5))

@@ -1,0 +1,184 @@
+"""End-to-end trainer tests — the minimum-slice proof (SURVEY.md §7 stage 5:
+mnist-style train + test pass + evaluator + checkpoint round-trip; reference
+pattern: paddle/trainer/tests/test_TrainerOnePass.cpp)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import evaluator, minibatch, optimizer as opt
+from paddle_tpu import layer as L
+from paddle_tpu import data_type as dt
+from paddle_tpu import activation as A
+from paddle_tpu.parameters import Parameters
+
+
+def _toy_classification_net(dim=8, classes=3):
+    x = L.data(name="x", type=dt.dense_vector(dim))
+    lab = L.data(name="y", type=dt.integer_value(classes))
+    h = L.fc(input=x, size=16, act=A.Tanh())
+    out = L.fc(input=h, size=classes)
+    cost = L.classification_cost(input=out, label=lab)
+    return x, lab, out, cost
+
+
+def _toy_reader(dim=8, classes=3, n=200, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        W = rng.randn(dim, classes)
+        for _ in range(n):
+            x = rng.randn(dim).astype(np.float32)
+            yield x, int(np.argmax(x @ W))
+
+    return reader
+
+
+def test_train_converges_and_eval():
+    x, lab, out, cost = _toy_classification_net()
+    params = Parameters.create(cost)
+    err = evaluator.classification_error(input=out, label=lab)
+    trainer = paddle.trainer.SGD(
+        cost, params, opt.Momentum(momentum=0.9, learning_rate=0.1),
+        extra_layers=[err])
+    costs = []
+    trainer.train(minibatch.batch(_toy_reader(), 20), num_passes=6,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if hasattr(e, "cost") and e.cost is not None else None)
+    assert costs[-1] < costs[0] * 0.5
+    result = trainer.test(minibatch.batch(_toy_reader(), 20))
+    assert result.metrics[err.name] < 0.2
+
+
+def test_parameters_tar_roundtrip_through_trainer():
+    x, lab, out, cost = _toy_classification_net()
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Momentum(learning_rate=0.05))
+    trainer.train(minibatch.batch(_toy_reader(n=60), 20), num_passes=2)
+    buf = io.BytesIO()
+    trainer.save_parameter_to_tar(buf)
+    buf.seek(0)
+    restored = Parameters.from_tar(buf)
+    for name in params.names():
+        np.testing.assert_allclose(restored.get(name), params.get(name),
+                                   rtol=1e-6)
+
+
+def test_inference_matches_training_forward():
+    x, lab, out, cost = _toy_classification_net()
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params, opt.Momentum(learning_rate=0.1))
+    trainer.train(minibatch.batch(_toy_reader(n=100), 20), num_passes=3)
+    batch = [s for s in _toy_reader(n=5)()]
+    probs = paddle.inference.infer(out, params, [(s[0],) for s in batch],
+                                   feeding={"x": 0})
+    assert probs.shape == (5, 3)
+    # inference predictions should match training-data labels mostly
+    preds = probs.argmax(axis=1)
+    labels = np.array([s[1] for s in batch])
+    assert (preds == labels).mean() >= 0.6
+
+
+def test_static_parameter_not_updated():
+    from paddle_tpu.attr import ParamAttr
+
+    x = L.data(name="x", type=dt.dense_vector(4))
+    lab = L.data(name="y", type=dt.integer_value(2))
+    frozen = L.fc(input=x, size=4, act=A.Tanh(),
+                  param_attr=ParamAttr(name="frozen_w", is_static=True),
+                  bias_attr=False)
+    out = L.fc(input=frozen, size=2)
+    cost = L.classification_cost(input=out, label=lab)
+    params = Parameters.create(cost)
+    before = params.get("frozen_w").copy()
+    trainer = paddle.trainer.SGD(cost, params, opt.Momentum(learning_rate=0.5))
+    trainer.train(minibatch.batch(_toy_reader(dim=4, classes=2, n=40), 20),
+                  num_passes=2)
+    np.testing.assert_array_equal(params.get("frozen_w"), before)
+
+
+def test_batchnorm_state_updates_in_training():
+    x = L.data(name="x", type=dt.dense_vector(6))
+    lab = L.data(name="y", type=dt.integer_value(2))
+    bn = L.batch_norm(input=L.fc(input=x, size=6), name="bn1")
+    out = L.fc(input=bn, size=2)
+    cost = L.classification_cost(input=out, label=lab)
+    params = Parameters.create(cost)
+    mean_before = params.get("bn1.moving_mean").copy()
+    trainer = paddle.trainer.SGD(cost, params, opt.Momentum(learning_rate=0.1))
+    trainer.train(minibatch.batch(_toy_reader(dim=6, classes=2, n=60), 20),
+                  num_passes=1)
+    assert not np.allclose(params.get("bn1.moving_mean"), mean_before)
+
+
+def test_regression_train():
+    x = L.data(name="x", type=dt.dense_vector(13))
+    y = L.data(name="y", type=dt.dense_vector(1))
+    pred = L.fc(input=x, size=1)
+    cost = L.square_error_cost(input=pred, label=y)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params, opt.Momentum(learning_rate=0.01))
+    from paddle_tpu.dataset import uci_housing
+
+    costs = []
+    trainer.train(minibatch.batch(uci_housing.train(), 32), num_passes=8,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if hasattr(e, "cost") and e.cost is not None else None)
+    assert costs[-1] < costs[0] * 0.5
+
+
+def test_sequence_model_train():
+    # tiny LSTM text classifier on synthetic separable text
+    dict_size, classes = 50, 2
+    words = L.data(name="word", type=dt.integer_value_sequence(dict_size))
+    lab = L.data(name="y", type=dt.integer_value(classes))
+    emb = L.embedding(input=words, size=8)
+    from paddle_tpu import networks
+
+    lstm = networks.simple_lstm(input=emb, size=8)
+    pooled = L.pooling(input=lstm, pooling_type=paddle.pooling.MaxPooling())
+    out = L.fc(input=pooled, size=classes, act=A.Softmax())
+    cost = L.cross_entropy(input=out, label=lab)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for i in range(120):
+            label = i % 2
+            length = rng.randint(3, 10)
+            lo, hi = (0, dict_size // 2) if label else (dict_size // 2, dict_size)
+            yield rng.randint(lo, hi, size=length).astype(np.int32), label
+
+    params = Parameters.create(cost)
+    err = evaluator.classification_error(input=out, label=lab)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Adam(learning_rate=0.01),
+                                 extra_layers=[err])
+    trainer.train(minibatch.batch(reader, 20), num_passes=4)
+    res = trainer.test(minibatch.batch(reader, 20))
+    assert res.metrics[err.name] < 0.2
+
+
+def test_from_tar_preserves_partition_metadata():
+    """Restored checkpoints must keep is_static/is_state partition
+    (regression: from_tar dropped manifest metadata)."""
+    from paddle_tpu.attr import ParamAttr
+
+    x = L.data(name="x", type=dt.dense_vector(4))
+    lab = L.data(name="y", type=dt.integer_value(2))
+    frozen = L.fc(input=x, size=4, param_attr=ParamAttr(name="fz", is_static=True),
+                  bias_attr=False)
+    bn = L.batch_norm(input=frozen, name="bnm")
+    cost = L.classification_cost(input=L.fc(input=bn, size=2), label=lab)
+    params = Parameters.create(cost)
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    buf.seek(0)
+    restored = Parameters.from_tar(buf)
+    trainable, static, state = restored.partition()
+    assert "fz" in static
+    assert "bnm.moving_mean" in state and "bnm.moving_var" in state
+    assert "fz" not in trainable
