@@ -1,0 +1,77 @@
+"""Benchmark driver: flagship LSTM text-classification training step.
+
+Mirrors the reference's headline RNN benchmark (BASELINE.md: 2x LSTM + fc,
+IMDB, seq len 100 padded, dict 30k, batch 64, hidden 256 — PaddlePaddle
+83 ms/batch, TF 175 ms/batch on a K40m; reference driver `paddle train
+--job=time`, benchmark/paddle/rnn/run.sh). Measures steady-state wall time
+of the fused train step (forward + backward + optimizer) on the real chip
+and prints ONE JSON line; vs_baseline > 1 means faster than the reference.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_MS = 83.0  # benchmark/README.md:119 — LSTM bs=64 h=256, K40m
+BATCH, SEQLEN, HIDDEN, DICT, EMB, CLASSES = 64, 100, 256, 30000, 128, 2
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.topology import Topology
+    from paddle_tpu import optimizer as opt
+    import __graft_entry__ as graft
+
+    words, label, out, cost = graft._flagship(
+        dict_size=DICT, emb=EMB, hidden=HIDDEN, classes=CLASSES)
+    topo = Topology(cost)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    optimizer = opt.Momentum(learning_rate=0.01, momentum=0.9)
+    opt_state = optimizer.init_state(params)
+
+    def train_step(params, opt_state, data, lengths, labels):
+        def loss_fn(p):
+            feed = {"word": SequenceBatch(data, lengths), "label": labels}
+            values, _ = topo.apply(p, feed, mode="test")
+            return jnp.mean(values[cost.name])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_state = optimizer.step(params, grads, opt_state)
+        return loss, new_params, new_state
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.randint(0, DICT, (BATCH, SEQLEN)), jnp.int32)
+    lengths = jnp.full((BATCH,), SEQLEN, jnp.int32)  # reference pads to 100
+    labels = jnp.asarray(rng.randint(0, CLASSES, (BATCH,)), jnp.int32)
+
+    # warmup / compile
+    loss, params, opt_state = jitted(params, opt_state, data, lengths, labels)
+    jax.block_until_ready(loss)
+
+    iters = 30
+    start = time.perf_counter()
+    for _ in range(iters):
+        loss, params, opt_state = jitted(params, opt_state, data, lengths,
+                                         labels)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+    ms_per_batch = elapsed / iters * 1000.0
+
+    print(json.dumps({
+        "metric": "lstm_text_cls_train_ms_per_batch_bs64_h256_seq100",
+        "value": round(ms_per_batch, 3),
+        "unit": "ms/batch",
+        "vs_baseline": round(BASELINE_MS / ms_per_batch, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

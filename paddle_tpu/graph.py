@@ -29,6 +29,7 @@ from paddle_tpu.utils.error import enforce, layer_scope
 
 _name_lock = threading.Lock()
 _name_counters = {}
+_creation_counter = itertools.count()
 
 
 def auto_name(layer_type):
@@ -47,7 +48,8 @@ class ParamSpec:
     """Declaration of one named parameter buffer (cf. ParameterConfig proto +
     Parameter, reference: paddle/parameter/Parameter.h:46)."""
 
-    __slots__ = ("name", "shape", "initializer", "attr", "dtype", "is_state")
+    __slots__ = ("name", "shape", "initializer", "attr", "dtype", "is_state",
+                 "sharding_hint")
 
     def __init__(self, name, shape, initializer, attr=None, dtype=None, is_state=False):
         self.name = name
@@ -56,6 +58,7 @@ class ParamSpec:
         self.attr = attr or ParamAttr()
         self.dtype = dtype
         self.is_state = is_state  # non-trainable running state (e.g. BN stats)
+        self.sharding_hint = None  # e.g. ("vocab", mesh_axis) for EP tables
 
     def materialize(self, rng, default_dtype):
         dtype = self.dtype or default_dtype
@@ -123,6 +126,9 @@ class LayerNode:
         self.extra_attr = extra_attr or ExtraAttr()
         self.seq_level = seq_level  # None=unknown, 0=plain, 1=seq, 2=nested
         self._forward_fn = forward_fn
+        # declaration order: the default feeding maps reader tuple columns to
+        # data layers in the order the user declared them (v2 semantics)
+        self.creation_index = next(_creation_counter)
 
     def forward(self, params, input_values, ctx):
         with layer_scope(self.name):

@@ -85,6 +85,23 @@ from paddle_tpu.layer.cost import (
     sum_cost,
 )
 from paddle_tpu.layer.recurrent import grumemory, lstmemory, recurrent
+from paddle_tpu.layer.extra import (
+    crf,
+    crf_decoding,
+    ctc,
+    hsigmoid,
+    nce,
+    warp_ctc,
+)
+from paddle_tpu.layer.rnn_group import (
+    BeamSearchGenerator,
+    GeneratedInput,
+    StaticInput,
+    beam_search,
+    get_output,
+    memory,
+    recurrent_group,
+)
 from paddle_tpu.layer.mixed import (
     BaseProjection,
     context_projection,

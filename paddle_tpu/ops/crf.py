@@ -1,0 +1,103 @@
+"""Linear-chain CRF: negative log-likelihood and Viterbi decode.
+
+Replaces the reference's LinearChainCRF (gserver/layers/LinearChainCRF.cpp
+— hand-written forward/backward/decode over start/transition/stop weights)
+with log-space lax.scan programs; jax.grad supplies the backward (the
+forward-backward marginals the reference coded by hand).
+
+Parameter layout parity (LinearChainCRF.cpp weight matrix (L+2) x L):
+row 0 = start scores a, row 1 = stop scores b, rows 2.. = transition W
+where W[i, j] scores moving from label i to label j.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _split_weights(w, num_labels):
+    start = w[0]
+    stop = w[1]
+    trans = w[2:]
+    return start, stop, trans
+
+
+def crf_nll(emissions, labels, mask, w):
+    """Negative log-likelihood of label paths.
+
+    emissions [B, T, L]; labels int32 [B, T]; mask [B, T] (>=1 valid step
+    per row); w [(L+2), L]. Returns per-sequence nll [B].
+    """
+    num_labels = emissions.shape[-1]
+    start, stop, trans = _split_weights(w, num_labels)
+    maskf = mask.astype(emissions.dtype)
+    # clip: out-of-range labels (e.g. in padding) must not index OOB
+    labels = jnp.clip(labels.astype(jnp.int32), 0, num_labels - 1)
+
+    # ---- path score -------------------------------------------------------
+    emit_scores = jnp.take_along_axis(emissions, labels[..., None], axis=-1)[..., 0]
+    emit_total = jnp.sum(emit_scores * maskf, axis=1)
+    start_total = jnp.take(start, labels[:, 0])
+    trans_steps = trans[labels[:, :-1], labels[:, 1:]]  # [B, T-1]
+    trans_total = jnp.sum(trans_steps * maskf[:, 1:], axis=1)
+    lengths = jnp.sum(mask, axis=1).astype(jnp.int32)
+    last_labels = jnp.take_along_axis(
+        labels, jnp.maximum(lengths - 1, 0)[:, None], axis=1)[:, 0]
+    stop_total = jnp.take(stop, last_labels)
+    path_score = emit_total + start_total + trans_total + stop_total
+
+    # ---- partition function (forward algorithm) ---------------------------
+    def body(alpha, xs):
+        emit_t, mask_t = xs  # [B, L], [B]
+        # alpha' = logsumexp_i(alpha_i + trans[i, j]) + emit_j
+        scores = alpha[:, :, None] + trans[None, :, :]
+        new_alpha = jax.scipy.special.logsumexp(scores, axis=1) + emit_t
+        alpha = jnp.where(mask_t[:, None] > 0, new_alpha, alpha)
+        return alpha, None
+
+    alpha0 = start[None, :] + emissions[:, 0, :]
+    em_tm = jnp.swapaxes(emissions[:, 1:, :], 0, 1)
+    mask_tm = jnp.swapaxes(mask[:, 1:], 0, 1)
+    alpha, _ = lax.scan(body, alpha0, (em_tm, mask_tm))
+    log_z = jax.scipy.special.logsumexp(alpha + stop[None, :], axis=1)
+
+    return log_z - path_score
+
+
+def crf_decode(emissions, mask, w):
+    """Viterbi decode. Returns (best_paths int32 [B, T], best_scores [B])."""
+    num_labels = emissions.shape[-1]
+    start, stop, trans = _split_weights(w, num_labels)
+
+    def body(carry, xs):
+        delta = carry
+        emit_t, mask_t = xs
+        scores = delta[:, :, None] + trans[None, :, :]  # [B, L_from, L_to]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        new_delta = jnp.max(scores, axis=1) + emit_t
+        new_delta = jnp.where(mask_t[:, None] > 0, new_delta, delta)
+        # keep identity backpointer on padded steps
+        idx = jnp.arange(num_labels, dtype=jnp.int32)[None, :]
+        bp = jnp.where(mask_t[:, None] > 0, best_prev, idx)
+        return new_delta, bp
+
+    delta0 = start[None, :] + emissions[:, 0, :]
+    em_tm = jnp.swapaxes(emissions[:, 1:, :], 0, 1)
+    mask_tm = jnp.swapaxes(mask[:, 1:], 0, 1)
+    delta, bps = lax.scan(body, delta0, (em_tm, mask_tm))
+    final = delta + stop[None, :]
+    best_last = jnp.argmax(final, axis=1).astype(jnp.int32)
+    best_score = jnp.max(final, axis=1)
+
+    # backtrace (reverse scan over backpointers)
+    def back(carry, bp_t):
+        cur = carry
+        prev = jnp.take_along_axis(bp_t, cur[:, None], axis=1)[:, 0]
+        return prev, cur
+
+    first, path_rest = lax.scan(back, best_last, bps, reverse=True)
+    # path_rest[t] = label at step t+1 (scan emits in input order); prepend
+    # the step-0 label carried out of the reverse scan
+    paths = jnp.concatenate([first[None, :], path_rest], axis=0)  # [T, B]
+    paths = jnp.swapaxes(paths, 0, 1).astype(jnp.int32)
+    return paths * mask.astype(jnp.int32), best_score

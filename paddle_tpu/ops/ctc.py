@@ -1,0 +1,96 @@
+"""CTC loss (Connectionist Temporal Classification).
+
+Replaces the reference's LinearChainCTC (gserver/layers/LinearChainCTC.cpp)
+and the warp-ctc binding (WarpCTCLayer, hl_warpctc_wrap.cc) with a log-space
+alpha recursion under lax.scan — one fused XLA program, batch-vectorized
+over the standard 2S+1 extended label sequence. Blank id = 0 (reference
+convention: LinearChainCTC uses blank 0).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _log_add(a, b):
+    mx = jnp.maximum(a, b)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    return mx + jnp.log(
+        jnp.exp(jnp.maximum(a - mx, NEG_INF)) + jnp.exp(jnp.maximum(b - mx, NEG_INF)))
+
+
+def ctc_loss(log_probs, input_lengths, labels, label_lengths, blank=0):
+    """Per-sample CTC negative log-likelihood.
+
+    log_probs [B, T, C] (log softmax over C incl. blank); input_lengths [B];
+    labels int32 [B, S] (padded with anything); label_lengths [B].
+    """
+    b, t_max, c = log_probs.shape
+    s_max = labels.shape[1]
+    ext = 2 * s_max + 1
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext_labels = jnp.full((b, ext), blank, jnp.int32)
+    ext_labels = ext_labels.at[:, 1::2].set(labels.astype(jnp.int32))
+    ext_valid = jnp.arange(ext)[None, :] < (2 * label_lengths[:, None] + 1)
+
+    # allowed skip transition s-2 -> s: only onto a non-blank that differs
+    # from the label two back
+    prev2 = jnp.concatenate(
+        [jnp.full((b, 2), -1, jnp.int32), ext_labels[:, :-2]], axis=1)
+    can_skip = (ext_labels != blank) & (ext_labels != prev2)
+
+    def emit(t):
+        # [B, ext] log prob of emitting ext_labels at time t
+        return jnp.take_along_axis(log_probs[:, t, :], ext_labels, axis=1)
+
+    alpha0 = jnp.full((b, ext), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(log_probs[:, 0, blank])
+    first_label = jnp.take_along_axis(
+        log_probs[:, 0, :], ext_labels[:, 1:2], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_lengths > 0, first_label, NEG_INF))
+
+    def body(alpha, t):
+        stay = alpha
+        from_prev = jnp.concatenate(
+            [jnp.full((b, 1), NEG_INF), alpha[:, :-1]], axis=1)
+        from_skip = jnp.concatenate(
+            [jnp.full((b, 2), NEG_INF), alpha[:, :-2]], axis=1)
+        from_skip = jnp.where(can_skip, from_skip, NEG_INF)
+        merged = _log_add(_log_add(stay, from_prev), from_skip)
+        new_alpha = merged + emit(t)
+        new_alpha = jnp.where(ext_valid, new_alpha, NEG_INF)
+        # freeze past each sequence's input length
+        active = (t < input_lengths)[:, None]
+        return jnp.where(active, new_alpha, alpha), None
+
+    alpha, _ = lax.scan(body, alpha0, jnp.arange(1, t_max))
+
+    # final: sum of last blank and last label positions
+    last_blank_idx = 2 * label_lengths  # index of final blank
+    last_label_idx = jnp.maximum(2 * label_lengths - 1, 0)
+    a_blank = jnp.take_along_axis(alpha, last_blank_idx[:, None], axis=1)[:, 0]
+    a_label = jnp.take_along_axis(alpha, last_label_idx[:, None], axis=1)[:, 0]
+    a_label = jnp.where(label_lengths > 0, a_label, NEG_INF)
+    ll = _log_add(a_blank, a_label)
+    return -ll
+
+
+def ctc_greedy_decode(log_probs, input_lengths, blank=0):
+    """Best-path decode: argmax per frame, collapse repeats, drop blanks.
+    Returns (ids [B, T] padded with -1, lengths [B])."""
+    ids = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)  # [B, T]
+    t = jnp.arange(ids.shape[1])[None, :]
+    valid = t < input_lengths[:, None]
+    prev = jnp.concatenate(
+        [jnp.full((ids.shape[0], 1), -1, jnp.int32), ids[:, :-1]], axis=1)
+    keep = valid & (ids != blank) & (ids != prev)
+    # stable left-compaction of kept ids
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(ids, order, axis=1)
+    kept_sorted = jnp.take_along_axis(keep, order, axis=1)
+    out = jnp.where(kept_sorted, compacted, -1)
+    return out, jnp.sum(keep, axis=1).astype(jnp.int32)

@@ -33,6 +33,14 @@ class Topology:
         self.data_layers = {
             n.name: n for n in self.nodes if n.layer_type == "data"
         }
+        # declaration-ordered (name, InputType) pairs, cached — convert_feed
+        # hits this twice per minibatch
+        self._data_types = [
+            (n.name, n.input_type)
+            for n in sorted(self.data_layers.values(),
+                            key=lambda n: n.creation_index)
+            if getattr(n, "input_type", None) is not None
+        ]
 
     # -- parameters ---------------------------------------------------------
     def param_specs(self):
@@ -100,12 +108,11 @@ class Topology:
         return values, ctx.state_updates
 
     def data_types(self):
-        """[(name, InputType)] for feeder construction (v2 Topology.data_type)."""
-        return [
-            (name, node.input_type)
-            for name, node in sorted(self.data_layers.items())
-            if getattr(node, "input_type", None) is not None
-        ]
+        """[(name, InputType)] for feeder construction, in *declaration
+        order* — the default feeding maps reader tuple columns to data layers
+        in the order the user created them (v2 Topology.data_type parity;
+        alphabetical order would silently swap e.g. ('word', 'label'))."""
+        return self._data_types
 
 
 def convert_feed(topology, data_batch, feeding=None):

@@ -1,0 +1,195 @@
+"""recurrent_group / memory / beam-search tests.
+
+Reference patterns: test_RecurrentGradientMachine.cpp (config-pair
+equivalence: recurrent_group vs built-in recurrent layer on the same
+weights), test_recurrent_machine_generation.cpp (beam-search generation
+vs golden outputs; beam=1 == greedy)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import layer as L
+from paddle_tpu import data_type as dt
+from paddle_tpu import activation as A
+from paddle_tpu.attr import ParamAttr
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.topology import Topology
+from tests.gradcheck import check_layer_grad
+
+
+def _seq_feed(name, dim, lengths=(3, 5), max_len=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {name: SequenceBatch.from_sequences(
+        [rng.randn(l, dim) for l in lengths], max_len=max_len)}
+
+
+def test_recurrent_group_equals_recurrent_layer():
+    """recurrent_group with step fc(x_t + mem, identity-act) must reproduce
+    the built-in recurrent layer when sharing the same recurrent weight
+    (config-pair equivalence, test_RecurrentGradientMachine pattern)."""
+    dim = 4
+    x = L.data(name="xs", type=dt.dense_vector_sequence(dim))
+
+    # built-in: h_t = tanh(x_t + h_{t-1} W)
+    builtin = L.recurrent(input=x, act=A.Tanh(),
+                          param_attr=ParamAttr(name="rec_w"), bias_attr=False)
+
+    # group: same math via memory + mixed projections
+    def step(x_t):
+        mem = L.memory(name="group_h", size=dim)
+        from paddle_tpu.layer.mixed import full_matrix_projection, identity_projection
+
+        h = L.mixed(size=dim, input=[
+            identity_projection(input=x_t),
+            full_matrix_projection(input=mem, size=dim,
+                                   param_attr=ParamAttr(name="rec_w")),
+        ], act=A.Tanh(), name="group_h")
+        return h
+
+    grouped = L.recurrent_group(step=step, input=x)
+
+    topo = Topology([builtin, grouped])
+    params = topo.init_params(jax.random.PRNGKey(0))
+    feed = _seq_feed("xs", dim)
+    vals, _ = topo.apply(params, feed, mode="test")
+    a, b = vals[builtin.name], vals[grouped.name]
+    np.testing.assert_allclose(np.asarray(a.data), np.asarray(b.data),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_recurrent_group_grad():
+    dim = 3
+    x = L.data(name="xs", type=dt.dense_vector_sequence(dim))
+
+    def step(x_t):
+        mem = L.memory(name="gh", size=dim)
+        return L.fc(input=[x_t, mem], size=dim, act=A.Tanh(), name="gh")
+
+    out = L.recurrent_group(step=step, input=x)
+    check_layer_grad(out, _seq_feed("xs", dim), rtol=5e-3)
+
+
+def test_recurrent_group_memory_boot_layer():
+    dim = 3
+    x = L.data(name="xs", type=dt.dense_vector_sequence(dim))
+    boot = L.data(name="boot", type=dt.dense_vector(dim))
+
+    def step(x_t):
+        mem = L.memory(name="bh", size=dim, boot_layer=boot)
+        return L.addto(input=[x_t, mem], name="bh")
+
+    out = L.recurrent_group(step=step, input=x)
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    seqs = [rng.randn(2, dim), rng.randn(3, dim)]
+    feed = {"xs": SequenceBatch.from_sequences(seqs, max_len=4),
+            "boot": jnp.asarray(rng.randn(2, dim))}
+    vals, _ = topo.apply(params, feed, mode="test")
+    out_data = np.asarray(vals[out.name].data)
+    # h_t = boot + sum_{i<=t} x_i  (addto accumulates)
+    boot_np = np.asarray(feed["boot"])
+    np.testing.assert_allclose(out_data[0, 0], boot_np[0] + seqs[0][0], rtol=1e-5)
+    np.testing.assert_allclose(out_data[0, 1],
+                               boot_np[0] + seqs[0][0] + seqs[0][1], rtol=1e-5)
+    # masking: output zero past sequence end
+    assert np.allclose(out_data[0, 2:], 0.0)
+
+
+def test_recurrent_group_static_input():
+    dim = 3
+    x = L.data(name="xs", type=dt.dense_vector_sequence(dim))
+    ctx_in = L.data(name="ctx", type=dt.dense_vector(dim))
+
+    def step(x_t, c):
+        return L.addto(input=[x_t, c], name="st_out")
+
+    out = L.recurrent_group(step=step, input=[x, L.StaticInput(input=ctx_in)])
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    seqs = [rng.randn(3, dim)]
+    feed = {"xs": SequenceBatch.from_sequences(seqs, max_len=4),
+            "ctx": jnp.asarray(rng.randn(1, dim))}
+    vals, _ = topo.apply(params, feed, mode="test")
+    np.testing.assert_allclose(np.asarray(vals[out.name].data)[0, 1],
+                               seqs[0][1] + np.asarray(feed["ctx"])[0],
+                               rtol=1e-5)
+
+
+def test_recurrent_group_reverse_matches_builtin():
+    dim = 4
+    x = L.data(name="xs", type=dt.dense_vector_sequence(dim))
+    builtin = L.recurrent(input=x, act=A.Tanh(), reverse=True,
+                          param_attr=ParamAttr(name="rev_w"), bias_attr=False)
+
+    def step(x_t):
+        mem = L.memory(name="rev_h", size=dim)
+        from paddle_tpu.layer.mixed import full_matrix_projection, identity_projection
+
+        return L.mixed(size=dim, input=[
+            identity_projection(input=x_t),
+            full_matrix_projection(input=mem, size=dim,
+                                   param_attr=ParamAttr(name="rev_w")),
+        ], act=A.Tanh(), name="rev_h")
+
+    grouped = L.recurrent_group(step=step, input=x, reverse=True)
+    topo = Topology([builtin, grouped])
+    params = topo.init_params(jax.random.PRNGKey(1))
+    feed = _seq_feed("xs", dim, lengths=(4, 2), seed=3)
+    vals, _ = topo.apply(params, feed, mode="test")
+    np.testing.assert_allclose(np.asarray(vals[builtin.name].data),
+                               np.asarray(vals[grouped.name].data),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _make_lm_generator(vocab=6, beam=2, max_len=5):
+    """Deterministic 'language model': next-token distribution depends only
+    on the embedding of the previous token through a fixed fc."""
+    def step(prev_emb):
+        mem = L.memory(name="lm_h", size=8)
+        h = L.fc(input=[prev_emb, mem], size=8, act=A.Tanh(), name="lm_h")
+        return L.fc(input=h, size=vocab, act=A.Softmax(), name="lm_out")
+
+    gen = L.beam_search(
+        step=step,
+        input=[L.GeneratedInput(size=vocab, embedding_name="lm_emb",
+                                embedding_size=4, bos_id=0, eos_id=1)],
+        bos_id=0, eos_id=1, beam_size=beam, max_length=max_len)
+    return gen
+
+
+def test_beam_search_runs_and_is_sorted():
+    gen = _make_lm_generator()
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.graph import ParamSpec
+    from paddle_tpu.initializer import Normal
+
+    params = Parameters()
+    # materialize generator params + the embedding table
+    specs = {s.name: s for s in gen.param_specs()}
+    specs["lm_emb"] = ParamSpec("lm_emb", (6, 4), Normal(std=1.0))
+    rng = jax.random.PRNGKey(0)
+    for i, (name, spec) in enumerate(sorted(specs.items())):
+        params._specs[name] = spec
+        params._values[name] = np.asarray(
+            spec.materialize(jax.random.fold_in(rng, i), jnp.float32))
+    seqs, lengths, scores = gen.generate(params)
+    assert seqs.shape[0] == 1 and seqs.shape[1] == 2
+    assert (scores[:, :-1] >= scores[:, 1:]).all()  # sorted best-first
+    # greedy (beam=1) top result equals beam's constrained greedy path
+    gen1 = _make_lm_generator(beam=1)
+    # share the same parameter values by name
+    params1 = Parameters()
+    specs1 = {s.name: s for s in gen1.param_specs()}
+    specs1["lm_emb"] = specs["lm_emb"]
+    for name, spec in specs1.items():
+        params1._specs[name] = spec
+        # map generator-local names: step layers share names lm_h/lm_out
+        params1._values[name] = params._values[name]
+    seqs1, lengths1, scores1 = gen1.generate(params1)
+    assert scores[0, 0] >= scores1[0, 0] - 1e-5  # beam>=greedy

@@ -1,0 +1,212 @@
+"""CRF / CTC / NCE / hsigmoid tests.
+
+Reference patterns: test_CRFLayerGrad.cpp (gradient + brute-force
+enumeration over tiny label spaces), test_LinearChainCRF.cpp,
+test_WarpCTCLayer.cpp (CTC vs reference implementation), test_LayerGrad
+cases for NCE/hsigmoid."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import crf as crf_ops
+from paddle_tpu.ops import ctc as ctc_ops
+from tests.gradcheck import check_layer_grad
+
+import paddle_tpu as paddle
+from paddle_tpu import layer as L
+from paddle_tpu import data_type as dt
+
+
+def brute_force_crf(emissions, labels_list, w):
+    """Enumerate all paths for one sequence; return (nll, best_path)."""
+    t, num_labels = emissions.shape
+    start, stop, trans = w[0], w[1], w[2:]
+
+    def path_score(path):
+        s = start[path[0]] + emissions[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + emissions[i, path[i]]
+        s += stop[path[-1]]
+        return s
+
+    scores = {p: path_score(p) for p in itertools.product(range(num_labels),
+                                                          repeat=t)}
+    all_scores = np.array(list(scores.values()))
+    log_z = np.log(np.sum(np.exp(all_scores - all_scores.max()))) + all_scores.max()
+    gold = path_score(labels_list)
+    best = max(scores, key=scores.get)
+    return log_z - gold, np.array(best)
+
+
+def test_crf_nll_matches_brute_force():
+    rng = np.random.RandomState(0)
+    t, labels_n = 4, 3
+    em = rng.randn(1, t, labels_n).astype(np.float64)
+    w = rng.randn(labels_n + 2, labels_n).astype(np.float64)
+    labels = rng.randint(0, labels_n, (1, t)).astype(np.int32)
+    mask = np.ones((1, t))
+    nll = crf_ops.crf_nll(jnp.asarray(em), jnp.asarray(labels),
+                          jnp.asarray(mask), jnp.asarray(w))
+    expected, _ = brute_force_crf(em[0], tuple(labels[0]), w)
+    np.testing.assert_allclose(float(nll[0]), expected, rtol=1e-6)
+
+
+def test_crf_nll_masking():
+    """Padding steps must not contribute: nll of a padded seq == nll of the
+    unpadded one."""
+    rng = np.random.RandomState(1)
+    labels_n = 3
+    em_short = rng.randn(1, 3, labels_n)
+    w = rng.randn(labels_n + 2, labels_n)
+    labels_short = rng.randint(0, labels_n, (1, 3)).astype(np.int32)
+    em_pad = np.concatenate([em_short, rng.randn(1, 2, labels_n)], axis=1)
+    labels_pad = np.concatenate(
+        [labels_short, np.zeros((1, 2), np.int32)], axis=1)
+    nll_short = crf_ops.crf_nll(jnp.asarray(em_short), jnp.asarray(labels_short),
+                                jnp.ones((1, 3)), jnp.asarray(w))
+    nll_pad = crf_ops.crf_nll(
+        jnp.asarray(em_pad), jnp.asarray(labels_pad),
+        jnp.asarray(np.concatenate([np.ones((1, 3)), np.zeros((1, 2))], 1)),
+        jnp.asarray(w))
+    np.testing.assert_allclose(float(nll_short[0]), float(nll_pad[0]), rtol=1e-6)
+
+
+def test_crf_decode_matches_brute_force():
+    rng = np.random.RandomState(2)
+    t, labels_n = 4, 3
+    em = rng.randn(2, t, labels_n)
+    w = rng.randn(labels_n + 2, labels_n)
+    mask = np.ones((2, t))
+    paths, scores = crf_ops.crf_decode(jnp.asarray(em), jnp.asarray(mask),
+                                       jnp.asarray(w))
+    for i in range(2):
+        _, best = brute_force_crf(em[i], (0,) * t, w)
+        np.testing.assert_array_equal(np.asarray(paths)[i], best)
+
+
+def test_crf_layer_grad():
+    scores = L.data(name="scores", type=dt.dense_vector_sequence(3))
+    labels = L.data(name="labels", type=dt.integer_value_sequence(3))
+    cost = L.crf(input=scores, label=labels, size=3)
+    rng = np.random.RandomState(0)
+    feed = {
+        "scores": SequenceBatch.from_sequences(
+            [rng.randn(4, 3), rng.randn(2, 3)], max_len=4),
+        "labels": SequenceBatch.from_sequences(
+            [rng.randint(0, 3, 4).astype(np.int32),
+             rng.randint(0, 3, 2).astype(np.int32)], max_len=4),
+    }
+    check_layer_grad(cost, feed, check_inputs=True, rtol=5e-3)
+
+
+def brute_force_ctc(logp, label):
+    """Sum probability over all alignments of `label` into T frames."""
+    t, c = logp.shape
+    total = -np.inf
+
+    def expand(seq):  # all CTC alignments producing seq
+        # enumerate all length-T strings over C, collapse, compare
+        return None
+
+    for frames in itertools.product(range(c), repeat=t):
+        collapsed = []
+        prev = None
+        for f in frames:
+            if f != prev and f != 0:
+                collapsed.append(f)
+            prev = f
+        if collapsed == list(label):
+            s = sum(logp[i, f] for i, f in enumerate(frames))
+            total = np.logaddexp(total, s)
+    return -total
+
+
+def test_ctc_matches_brute_force():
+    rng = np.random.RandomState(0)
+    t, c = 5, 3  # 3^5 = 243 alignments, enumerable
+    logits = rng.randn(1, t, c)
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    label = [2, 1]
+    nll = ctc_ops.ctc_loss(jnp.asarray(logp), jnp.asarray([t]),
+                           jnp.asarray([[2, 1, 0]], jnp.int32),
+                           jnp.asarray([2]))
+    expected = brute_force_ctc(logp[0], label)
+    np.testing.assert_allclose(float(nll[0]), expected, rtol=1e-5)
+
+
+def test_ctc_layer_grad():
+    scores = L.data(name="sc", type=dt.dense_vector_sequence(4))
+    labels = L.data(name="lb", type=dt.integer_value_sequence(4))
+    cost = L.ctc(input=scores, label=labels, size=4)
+    rng = np.random.RandomState(1)
+    feed = {
+        "sc": SequenceBatch.from_sequences(
+            [rng.randn(6, 4), rng.randn(5, 4)], max_len=8),
+        "lb": SequenceBatch.from_sequences(
+            [np.array([1, 2], np.int32), np.array([3], np.int32)], max_len=4),
+    }
+    check_layer_grad(cost, feed, check_inputs=True, rtol=5e-3)
+
+
+def test_ctc_greedy_decode():
+    # frames: [a a blank b b] -> [a, b]
+    logp = np.full((1, 5, 3), -10.0)
+    for t, c in enumerate([1, 1, 0, 2, 2]):
+        logp[0, t, c] = 0.0
+    ids, lens = ctc_ops.ctc_greedy_decode(jnp.asarray(logp), jnp.asarray([5]))
+    assert int(lens[0]) == 2
+    np.testing.assert_array_equal(np.asarray(ids)[0, :2], [1, 2])
+
+
+def test_nce_layer_trains():
+    import jax.numpy as jnp
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu import optimizer as opt, minibatch
+
+    x = L.data(name="x", type=dt.dense_vector(8))
+    lab = L.data(name="y", type=dt.integer_value(20))
+    feat = L.fc(input=x, size=16, act=paddle.activation.Tanh())
+    cost = L.nce(input=feat, label=lab, num_classes=20, num_neg_samples=5)
+    params = Parameters.create(cost)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        W = rng.randn(8, 20)
+        for _ in range(150):
+            xx = rng.randn(8).astype(np.float32)
+            yield xx, int(np.argmax(xx @ W))
+
+    trainer = paddle.trainer.SGD(cost, params, opt.Adam(learning_rate=0.02))
+    costs = []
+    trainer.train(minibatch.batch(reader, 30), num_passes=4,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if hasattr(e, "cost") and e.cost is not None else None)
+    assert costs[-1] < costs[0] * 0.8
+
+
+def test_hsigmoid_grad_and_prob():
+    x = L.data(name="x", type=dt.dense_vector(5))
+    lab = L.data(name="y", type=dt.integer_value(8))
+    cost = L.hsigmoid(input=x, label=lab, num_classes=8)
+    rng = np.random.RandomState(0)
+    feed = {"x": jnp.asarray(rng.randn(3, 5)),
+            "y": jnp.asarray([0, 3, 7], jnp.int32)}
+    check_layer_grad(cost, feed, check_inputs=True)
+
+    # probabilities over all classes should sum to 1 (complete binary tree)
+    from paddle_tpu.topology import Topology
+
+    topo = Topology(cost)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    total = 0.0
+    for c in range(8):
+        f = {"x": feed["x"][:1], "y": jnp.asarray([c], jnp.int32)}
+        vals, _ = topo.apply(params, f, mode="test")
+        total += np.exp(-float(vals[cost.name][0]))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)

@@ -93,3 +93,21 @@ def test_places():
     assert CPUPlace(0) == CPUPlace(0)
     assert CPUPlace(0) != TPUPlace(0)
     assert default_place() is not None
+
+
+def test_convert_feed_declaration_order():
+    """Default feeding must follow data-layer declaration order, not
+    alphabetical (regression: ('word','label') got swapped)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu import layer as L, data_type as dtp
+    from paddle_tpu.topology import Topology, convert_feed
+
+    w = L.data(name="zz_first", type=dtp.dense_vector(2))
+    lab = L.data(name="aa_second", type=dtp.integer_value(3))
+    cost = L.classification_cost(input=L.fc(input=w, size=3), label=lab)
+    topo = Topology(cost)
+    batch = [(np.ones(2, np.float32), 1), (np.zeros(2, np.float32), 2)]
+    feed = convert_feed(topo, batch)
+    np.testing.assert_array_equal(np.asarray(feed["aa_second"]), [1, 2])
+    np.testing.assert_array_equal(np.asarray(feed["zz_first"]).shape, (2, 2))
