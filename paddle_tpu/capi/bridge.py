@@ -1,0 +1,96 @@
+"""Python side of the C inference API (called from capi.cc via the embedded
+interpreter). Owns model construction, parameter loading, and the compiled
+forward; ships float32 row-major bytes back to C."""
+
+import importlib
+import struct
+
+import numpy as np
+
+_initialized = False
+
+
+def initialize(use_tpu):
+    global _initialized
+    if _initialized:
+        return True
+    import paddle_tpu as paddle
+
+    paddle.init(use_tpu=bool(use_tpu))
+    _initialized = True
+    return True
+
+
+class _Model:
+    def __init__(self, builder_spec, params_tar):
+        from paddle_tpu.inference import Inference
+        from paddle_tpu.parameters import Parameters
+        from paddle_tpu.graph import reset_name_counters
+
+        module_name, _, fn_name = builder_spec.partition(":")
+        if not fn_name:
+            raise ValueError(
+                "builder must be 'module.path:function', got %r" % builder_spec)
+        builder = getattr(importlib.import_module(module_name), fn_name)
+        reset_name_counters()
+        output_layer = builder()
+        with open(params_tar, "rb") as f:
+            params = Parameters.from_tar(f)
+        self.inference = Inference(output_layer, params)
+        self.topology = self.inference.topology
+        names = [name for name, _ in self.topology.data_types()]
+        if len(names) != 1:
+            # inference over the output subgraph usually has one data leaf;
+            # callers with more must name the input explicitly
+            self.default_input = None
+        else:
+            self.default_input = names[0]
+        self.input_types = dict(self.topology.data_types())
+
+    def resolve_input(self, input_name):
+        name = input_name or self.default_input
+        if name is None or name not in self.input_types:
+            raise KeyError(
+                "unknown input %r (data layers: %s)"
+                % (input_name, sorted(self.input_types)))
+        return name
+
+
+def model_create(builder_spec, params_tar):
+    return _Model(builder_spec, params_tar)
+
+
+def _pack(out):
+    arr = np.ascontiguousarray(np.asarray(out, dtype=np.float32))
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    if arr.ndim > 2:
+        arr = arr.reshape(arr.shape[0], -1)
+    return arr.tobytes(), arr.shape[0], arr.shape[1]
+
+
+def model_forward_dense(model, input_name, data_bytes, height, width):
+    import jax.numpy as jnp
+
+    name = model.resolve_input(input_name)
+    arr = np.frombuffer(data_bytes, dtype=np.float32).reshape(height, width)
+    feed = {name: jnp.asarray(arr)}
+    out = model.inference._forward(model.inference._params, feed)
+    value = out[model.inference.outputs[0].name]
+    data = value.data if hasattr(value, "lengths") else value
+    return _pack(data)
+
+
+def model_forward_ids(model, input_name, id_bytes, seq_starts):
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.sequence import SequenceBatch
+
+    name = model.resolve_input(input_name)
+    flat = np.frombuffer(id_bytes, dtype=np.int32)
+    sb = SequenceBatch.from_flat(flat, np.asarray(seq_starts, np.int64))
+    feed = {name: sb}
+    out = model.inference._forward(model.inference._params, feed)
+    value = out[model.inference.outputs[0].name]
+    data = value.data if hasattr(value, "lengths") else value
+    return _pack(data)

@@ -1,0 +1,229 @@
+// C inference API implementation: embeds CPython and drives
+// paddle_tpu.capi.bridge (the numpy/topology heavy lifting stays in Python;
+// this file owns the C ABI, interpreter lifecycle, GIL discipline and
+// buffer marshalling). Parity role: paddle/capi/gradient_machine.cpp +
+// matrix.cpp, with PyDataProvider2-style embedded-Python technique
+// (reference embeds Python in C++ the same direction:
+// paddle/utils/PythonUtil.h).
+//
+// Build: make -C paddle_tpu/capi   ->  libpaddle_tpu_capi.so
+
+#include "paddle_tpu_capi.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::mutex g_mu;
+bool g_initialized = false;
+PyObject* g_bridge = nullptr;  // paddle_tpu.capi.bridge module
+char g_last_error[4096] = "";
+
+void set_last_error_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      snprintf(g_last_error, sizeof g_last_error, "%s", PyUnicode_AsUTF8(s));
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct Matrix {
+  uint64_t height = 0, width = 0;
+  std::vector<float> data;
+};
+
+struct GilGuard {
+  PyGILState_STATE state;
+  GilGuard() : state(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(state); }
+};
+
+}  // namespace
+
+extern "C" {
+
+pt_error pt_init(int use_tpu) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_initialized) return PT_NO_ERROR;
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  pt_error err = PT_NO_ERROR;
+  {
+    GilGuard gil;
+    PyObject* mod = PyImport_ImportModule("paddle_tpu.capi.bridge");
+    if (!mod) {
+      set_last_error_from_python();
+      err = PT_RUNTIME_ERROR;
+    } else {
+      PyObject* res = PyObject_CallMethod(mod, "initialize", "i", use_tpu);
+      if (!res) {
+        set_last_error_from_python();
+        Py_DECREF(mod);
+        err = PT_RUNTIME_ERROR;
+      } else {
+        Py_DECREF(res);
+        g_bridge = mod;
+        g_initialized = true;
+      }
+    }
+  }
+  if (we_initialized) {
+    // Release the GIL the interpreter start-up left held by this thread;
+    // otherwise every other thread's PyGILState_Ensure deadlocks. When the
+    // host process is itself Python (ctypes), the caller keeps its GIL.
+    PyEval_SaveThread();
+  }
+  return err;
+}
+
+const char* pt_last_error(void) { return g_last_error; }
+
+pt_error pt_model_create(pt_model* out, const char* builder,
+                         const char* params_tar) {
+  if (!out || !builder || !params_tar) return PT_NULLPTR_ERROR;
+  if (!g_initialized) return PT_NOT_INITIALIZED;
+  GilGuard gil;
+  PyObject* handle = PyObject_CallMethod(g_bridge, "model_create", "ss",
+                                         builder, params_tar);
+  if (!handle) {
+    set_last_error_from_python();
+    return PT_RUNTIME_ERROR;
+  }
+  *out = handle;  // borrowed by C caller; released in pt_model_destroy
+  return PT_NO_ERROR;
+}
+
+pt_error pt_model_destroy(pt_model model) {
+  if (!model) return PT_NULLPTR_ERROR;
+  GilGuard gil;
+  Py_DECREF((PyObject*)model);
+  return PT_NO_ERROR;
+}
+
+pt_error pt_matrix_create(pt_matrix* out, uint64_t height, uint64_t width) {
+  if (!out) return PT_NULLPTR_ERROR;
+  auto* m = new Matrix;
+  m->height = height;
+  m->width = width;
+  m->data.assign(height * width, 0.0f);
+  *out = m;
+  return PT_NO_ERROR;
+}
+
+pt_error pt_matrix_destroy(pt_matrix mat) {
+  if (!mat) return PT_NULLPTR_ERROR;
+  delete (Matrix*)mat;
+  return PT_NO_ERROR;
+}
+
+pt_error pt_matrix_get_shape(pt_matrix mat, uint64_t* h, uint64_t* w) {
+  if (!mat || !h || !w) return PT_NULLPTR_ERROR;
+  auto* m = (Matrix*)mat;
+  *h = m->height;
+  *w = m->width;
+  return PT_NO_ERROR;
+}
+
+pt_error pt_matrix_get_row(pt_matrix mat, uint64_t row, float** row_ptr) {
+  if (!mat || !row_ptr) return PT_NULLPTR_ERROR;
+  auto* m = (Matrix*)mat;
+  if (row >= m->height) return PT_OUT_OF_RANGE;
+  *row_ptr = m->data.data() + row * m->width;
+  return PT_NO_ERROR;
+}
+
+pt_error pt_matrix_set_value(pt_matrix mat, const float* values) {
+  if (!mat || !values) return PT_NULLPTR_ERROR;
+  auto* m = (Matrix*)mat;
+  memcpy(m->data.data(), values, m->data.size() * sizeof(float));
+  return PT_NO_ERROR;
+}
+
+pt_error pt_matrix_get_value(pt_matrix mat, float* dst) {
+  if (!mat || !dst) return PT_NULLPTR_ERROR;
+  auto* m = (Matrix*)mat;
+  memcpy(dst, m->data.data(), m->data.size() * sizeof(float));
+  return PT_NO_ERROR;
+}
+
+static pt_error run_forward(PyObject* result, pt_matrix* output) {
+  // result: (bytes, height, width) float32 row-major
+  PyObject* buf;
+  unsigned long long h, w;
+  if (!PyArg_ParseTuple(result, "SKK", &buf, &h, &w)) {
+    set_last_error_from_python();
+    Py_DECREF(result);
+    return PT_RUNTIME_ERROR;
+  }
+  auto* m = new Matrix;
+  m->height = h;
+  m->width = w;
+  m->data.resize(h * w);
+  memcpy(m->data.data(), PyBytes_AsString(buf), h * w * sizeof(float));
+  Py_DECREF(result);
+  *output = m;
+  return PT_NO_ERROR;
+}
+
+pt_error pt_model_forward(pt_model model, const char* input_name,
+                          pt_matrix input, pt_matrix* output) {
+  if (!model || !input || !output) return PT_NULLPTR_ERROR;
+  if (!g_initialized) return PT_NOT_INITIALIZED;
+  auto* in = (Matrix*)input;
+  GilGuard gil;
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      (const char*)in->data.data(), in->data.size() * sizeof(float));
+  PyObject* result = PyObject_CallMethod(
+      g_bridge, "model_forward_dense", "OsOKK", (PyObject*)model,
+      input_name ? input_name : "", bytes,
+      (unsigned long long)in->height, (unsigned long long)in->width);
+  Py_DECREF(bytes);
+  if (!result) {
+    set_last_error_from_python();
+    return PT_RUNTIME_ERROR;
+  }
+  return run_forward(result, output);
+}
+
+pt_error pt_model_forward_ids(pt_model model, const char* input_name,
+                              const int32_t* ids, uint64_t total_len,
+                              const uint64_t* seq_starts, uint64_t num_seqs,
+                              pt_matrix* output) {
+  if (!model || !ids || !seq_starts || !output) return PT_NULLPTR_ERROR;
+  if (!g_initialized) return PT_NOT_INITIALIZED;
+  GilGuard gil;
+  PyObject* id_bytes = PyBytes_FromStringAndSize(
+      (const char*)ids, total_len * sizeof(int32_t));
+  PyObject* pos = PyList_New(num_seqs + 1);
+  for (uint64_t i = 0; i <= num_seqs; i++) {
+    PyList_SetItem(pos, i, PyLong_FromUnsignedLongLong(seq_starts[i]));
+  }
+  PyObject* result = PyObject_CallMethod(
+      g_bridge, "model_forward_ids", "OsOO", (PyObject*)model,
+      input_name ? input_name : "", id_bytes, pos);
+  Py_DECREF(id_bytes);
+  Py_DECREF(pos);
+  if (!result) {
+    set_last_error_from_python();
+    return PT_RUNTIME_ERROR;
+  }
+  return run_forward(result, output);
+}
+
+}  // extern "C"

@@ -1,0 +1,53 @@
+/* C inference example (≙ paddle/capi/examples/model_inference/dense):
+ * loads a model built by a named Python topology builder + parameter tar,
+ * runs a dense forward, prints the output row. Usage:
+ *   infer_dense <builder "mod:fn"> <params.tar> <in_dim> */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_tpu_capi.h"
+
+#define CHECK(stmt)                                                     \
+  do {                                                                  \
+    pt_error err__ = (stmt);                                            \
+    if (err__ != PT_NO_ERROR) {                                         \
+      fprintf(stderr, "FAIL %s -> %d: %s\n", #stmt, err__,              \
+              pt_last_error());                                         \
+      return 1;                                                         \
+    }                                                                   \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <builder> <params.tar> <in_dim>\n", argv[0]);
+    return 2;
+  }
+  unsigned long in_dim = strtoul(argv[3], NULL, 10);
+
+  CHECK(pt_init(/*use_tpu=*/0));
+
+  pt_model model = NULL;
+  CHECK(pt_model_create(&model, argv[1], argv[2]));
+
+  pt_matrix input = NULL;
+  CHECK(pt_matrix_create(&input, 1, in_dim));
+  float* row = NULL;
+  CHECK(pt_matrix_get_row(input, 0, &row));
+  for (unsigned long i = 0; i < in_dim; i++) row[i] = 0.1f * (float)(i % 10);
+
+  pt_matrix output = NULL;
+  CHECK(pt_model_forward(model, "", input, &output));
+
+  uint64_t h, w;
+  CHECK(pt_matrix_get_shape(output, &h, &w));
+  printf("output %llu x %llu:", (unsigned long long)h, (unsigned long long)w);
+  CHECK(pt_matrix_get_row(output, 0, &row));
+  for (uint64_t i = 0; i < w && i < 16; i++) printf(" %.5f", row[i]);
+  printf("\n");
+
+  CHECK(pt_matrix_destroy(input));
+  CHECK(pt_matrix_destroy(output));
+  CHECK(pt_model_destroy(model));
+  printf("C-API OK\n");
+  return 0;
+}

@@ -1,0 +1,76 @@
+/* Pure-C inference API.
+ *
+ * Surface parity with the reference's deployment C API (paddle/capi:
+ * paddle_init, paddle_gradient_machine_create_for_inference(_with_parameters)
+ * gradient_machine.h:36-59, paddle_matrix_* matrix.h:39-88,
+ * paddle_arguments_* arguments.h) re-shaped for the TPU stack: a "model" is
+ * a named Python topology builder (e.g. "paddle_tpu.models.vision:lenet")
+ * plus a parameters tar — the merged-model role — and forward runs the
+ * jit-compiled XLA program. The library embeds CPython; the C caller never
+ * sees Python.
+ *
+ * Thread-safety: calls are serialized on the embedded interpreter's GIL.
+ */
+
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  PT_NO_ERROR = 0,
+  PT_NULLPTR_ERROR = 1,
+  PT_OUT_OF_RANGE = 2,
+  PT_RUNTIME_ERROR = 3,
+  PT_NOT_INITIALIZED = 4,
+} pt_error;
+
+typedef void* pt_model;     /* ≙ paddle_gradient_machine (inference mode) */
+typedef void* pt_matrix;    /* ≙ paddle_matrix: row-major float32 buffer  */
+
+/* Initialize the runtime (≙ paddle_init). use_tpu=0 forces CPU.
+ * Must be called once before any other API. */
+pt_error pt_init(int use_tpu);
+
+/* Last error detail for PT_RUNTIME_ERROR (static buffer, do not free). */
+const char* pt_last_error(void);
+
+/* Create an inference model:
+ *   builder: "module.path:function" returning the output layer
+ *   params_tar: path to a Parameters tar (to_tar format)
+ * ≙ paddle_gradient_machine_create_for_inference_with_parameters */
+pt_error pt_model_create(pt_model* out, const char* builder,
+                         const char* params_tar);
+pt_error pt_model_destroy(pt_model model);
+
+/* Matrices (row-major float32, ≙ paddle_matrix_create). */
+pt_error pt_matrix_create(pt_matrix* out, uint64_t height, uint64_t width);
+pt_error pt_matrix_destroy(pt_matrix mat);
+pt_error pt_matrix_get_shape(pt_matrix mat, uint64_t* height, uint64_t* width);
+/* Direct pointer to row `row` (mutable; ≙ paddle_matrix_get_row). */
+pt_error pt_matrix_get_row(pt_matrix mat, uint64_t row, float** row_ptr);
+pt_error pt_matrix_set_value(pt_matrix mat, const float* values); /* h*w */
+pt_error pt_matrix_get_value(pt_matrix mat, float* dst);          /* h*w */
+
+/* Forward: dense input [batch, in_dim] -> output matrix (allocated by the
+ * call; destroy with pt_matrix_destroy). ≙ paddle_gradient_machine_forward.
+ * input_name: data-layer name ("" = the model's single data layer). */
+pt_error pt_model_forward(pt_model model, const char* input_name,
+                          pt_matrix input, pt_matrix* output);
+
+/* Sequence forward: flat int32 ids + start positions (reference
+ * sequenceStartPositions layout, paddle_arguments_set_sequence_start_pos).
+ * ids: [total_len]; seq_starts: [num_seqs+1]. */
+pt_error pt_model_forward_ids(pt_model model, const char* input_name,
+                              const int32_t* ids, uint64_t total_len,
+                              const uint64_t* seq_starts, uint64_t num_seqs,
+                              pt_matrix* output);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H */

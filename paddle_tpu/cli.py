@@ -1,0 +1,211 @@
+"""Command-line launcher.
+
+Parity with the reference's CLI surface (paddle/trainer/TrainerMain.cpp —
+jobs train/test/time; paddle/scripts `paddle train --config=...`;
+MergeModel.cpp). The config is a Python module defining the topology
+(the reference also executed Python for configs — config_parser.py via the
+embedded interpreter — so a Python config file is the faithful shape):
+
+    python -m paddle_tpu.cli train --config my_config.py --num-passes 5
+    python -m paddle_tpu.cli time  --config my_config.py --iters 50
+    python -m paddle_tpu.cli test  --config my_config.py --params ckpt.tar
+    python -m paddle_tpu.cli merge_model --config c.py --params p.tar -o m.tar
+
+The config module must define ``cost()`` returning the cost layer (and may
+define ``optimizer()``, ``train_reader()``, ``test_reader()``,
+``batch_size``). A checkgrad job mirrors --job=checkgrad
+(Trainer::checkGradient, Trainer.cpp:299) using the float64 harness.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+
+def _load_config(path):
+    spec = importlib.util.spec_from_file_location("paddle_tpu_user_config",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_user_config"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _build(cfg):
+    import paddle_tpu as paddle
+    from paddle_tpu.parameters import Parameters
+
+    cost = cfg.cost()
+    params = Parameters.create(cost)
+    if hasattr(cfg, "optimizer"):
+        optimizer = cfg.optimizer()
+    else:
+        from paddle_tpu import optimizer as opt
+
+        optimizer = opt.Momentum(learning_rate=0.01, momentum=0.9)
+    extra = list(cfg.evaluators()) if hasattr(cfg, "evaluators") else None
+    trainer = paddle.trainer.SGD(cost, params, optimizer, extra_layers=extra)
+    return cost, params, trainer
+
+
+def cmd_train(args):
+    import paddle_tpu as paddle
+    from paddle_tpu import minibatch
+
+    cfg = _load_config(args.config)
+    cost, params, trainer = _build(cfg)
+    batch_size = getattr(cfg, "batch_size", args.batch_size)
+    reader = minibatch.batch(cfg.train_reader(), batch_size)
+    if args.init_model:
+        trainer.restore_checkpoint(args.init_model)
+
+    save_dir = args.save_dir
+
+    def handler(event):
+        import paddle_tpu.event as ev
+
+        if isinstance(event, ev.EndPass) and save_dir:
+            trainer.save_checkpoint(save_dir, pass_id=event.pass_id)
+
+    trainer.train(reader, num_passes=args.num_passes, event_handler=handler)
+    if hasattr(cfg, "test_reader"):
+        result = trainer.test(minibatch.batch(cfg.test_reader(), batch_size))
+        print("test cost=%.6f metrics=%s" % (result.cost, result.metrics))
+    return 0
+
+
+def cmd_test(args):
+    import paddle_tpu as paddle
+    from paddle_tpu import minibatch
+
+    cfg = _load_config(args.config)
+    cost, params, trainer = _build(cfg)
+    if args.params:
+        with open(args.params, "rb") as f:
+            params.init_from_tar(f)
+        trainer.__prepare__()
+    result = trainer.test(
+        minibatch.batch(cfg.test_reader(), getattr(cfg, "batch_size",
+                                                   args.batch_size)))
+    print("test cost=%.6f metrics=%s" % (result.cost, result.metrics))
+    return 0
+
+
+def cmd_time(args):
+    """--job=time parity (TrainerBenchmark.cpp): steady-state ms/batch."""
+    import jax
+
+    from paddle_tpu import minibatch
+
+    cfg = _load_config(args.config)
+    cost, params, trainer = _build(cfg)
+    batch_size = getattr(cfg, "batch_size", args.batch_size)
+    batches = list(minibatch.batch(cfg.train_reader(), batch_size)())
+    if not batches:
+        print("no data")
+        return 1
+    feed_batches = batches[: max(args.iters, 1)]
+    # warmup (compile)
+    trainer.train(lambda: iter(feed_batches[:1]), num_passes=1)
+    start = time.perf_counter()
+    count = 0
+    for batch in feed_batches:
+        trainer.train(lambda b=batch: iter([b]), num_passes=1,
+                      sync_params=False)
+        count += 1
+    jax.block_until_ready(trainer._trainable)
+    elapsed = (time.perf_counter() - start) / count * 1000.0
+    print(json.dumps({"ms_per_batch": round(elapsed, 3),
+                      "batch_size": batch_size, "batches": count}))
+    return 0
+
+
+def cmd_checkgrad(args):
+    """--job=checkgrad parity: numeric-vs-analytic on the user's config."""
+    from paddle_tpu.checkgrad import check_layer_grad  # float64 harness
+    from paddle_tpu import minibatch
+    from paddle_tpu.topology import Topology, convert_feed
+
+    cfg = _load_config(args.config)
+    cost = cfg.cost()
+    topo = Topology(cost)
+    batch = next(iter(minibatch.batch(cfg.train_reader(),
+                                      getattr(cfg, "batch_size", 8))()))
+    feed = convert_feed(topo, batch)
+    check_layer_grad(cost, feed, check_inputs=False)
+    print("checkgrad PASSED")
+    return 0
+
+
+def cmd_merge_model(args):
+    """MergeModel.cpp parity: bundle builder spec + params into one tar."""
+    import tarfile
+    import io
+
+    with open(args.params, "rb") as f:
+        payload = f.read()
+    manifest = json.dumps({
+        "format": "paddle_tpu-merged-model-v1",
+        "builder": args.builder or "",
+        "config_file": os.path.basename(args.config or ""),
+    }).encode()
+    with tarfile.open(args.output, "w") as tar:
+        info = tarfile.TarInfo("merged_manifest.json")
+        info.size = len(manifest)
+        tar.addfile(info, io.BytesIO(manifest))
+        info = tarfile.TarInfo("parameters.tar")
+        info.size = len(payload)
+        tar.addfile(info, io.BytesIO(payload))
+        if args.config:
+            tar.add(args.config, arcname=os.path.basename(args.config))
+    print("merged model written to", args.output)
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="paddle_tpu",
+                                     description="paddle_tpu launcher")
+    sub = parser.add_subparsers(dest="job", required=True)
+
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--config", required=True)
+    common.add_argument("--batch-size", type=int, default=64)
+    common.add_argument("--use-tpu", action="store_true", default=None)
+
+    p = sub.add_parser("train", parents=[common])
+    p.add_argument("--num-passes", type=int, default=1)
+    p.add_argument("--save-dir", default="")
+    p.add_argument("--init-model", default="")
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("test", parents=[common])
+    p.add_argument("--params", default="")
+    p.set_defaults(fn=cmd_test)
+
+    p = sub.add_parser("time", parents=[common])
+    p.add_argument("--iters", type=int, default=20)
+    p.set_defaults(fn=cmd_time)
+
+    p = sub.add_parser("checkgrad", parents=[common])
+    p.set_defaults(fn=cmd_checkgrad)
+
+    p = sub.add_parser("merge_model")
+    p.add_argument("--config", default="")
+    p.add_argument("--builder", default="")
+    p.add_argument("--params", required=True)
+    p.add_argument("-o", "--output", required=True)
+    p.set_defaults(fn=cmd_merge_model)
+
+    args = parser.parse_args(argv)
+    if getattr(args, "use_tpu", None) is not None:
+        import paddle_tpu as paddle
+
+        paddle.init(use_tpu=args.use_tpu)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""Distributed runtime: elastic coordinator client, durable checkpointing,
+multi-host initialization.
+
+Role parity with the reference's Go runtime (go/master + go/pserver,
+SURVEY.md §2.2) minus the parameter-server gradient path, which XLA
+collectives over ICI replace entirely (pserver-free design). What remains —
+and lives here — is the state that must outlive accelerators: task dispatch
+with elasticity, checkpoint/restore with integrity + election, and
+membership.
+"""
+
+from paddle_tpu.distributed.client import CoordinatorClient, spawn_coordinator
+from paddle_tpu.distributed.checkpoint import (
+    load_checkpoint,
+    latest_checkpoint,
+    save_checkpoint,
+)
+from paddle_tpu.distributed.multihost import initialize_multihost

@@ -1,0 +1,169 @@
+"""Python client for the C++ coordinator (go/master/client.go parity).
+
+The trainer pulls task chunks from the coordinator instead of iterating a
+local dataset — workers can die and rejoin, tasks time out and requeue,
+poison tasks are dropped after failure_max (reference: go/master
+client.go:111-231 + service.go task lifecycle)."""
+
+import json
+import os
+import socket
+import subprocess
+import time
+
+from paddle_tpu.utils.error import enforce
+from paddle_tpu.utils.logger import logger
+
+COORDINATOR_BIN = os.path.join(os.path.dirname(__file__), "coordinator",
+                               "coordinator")
+
+
+def spawn_coordinator(port, snapshot_path="", task_timeout=600.0,
+                      failure_max=3, build_if_missing=True):
+    """Start a coordinator subprocess on localhost; returns the Popen."""
+    if not os.path.exists(COORDINATOR_BIN) and build_if_missing:
+        subprocess.run(["make", "-C", os.path.dirname(COORDINATOR_BIN)],
+                       check=True, capture_output=True)
+    proc = subprocess.Popen(
+        [COORDINATOR_BIN, str(port), snapshot_path, str(task_timeout),
+         str(failure_max)],
+        stderr=subprocess.PIPE)
+    # wait for the listening line; surface startup failures (e.g. bind)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        line = proc.stderr.readline().decode()
+        if "listening" in line:
+            return proc
+        if line == "" or proc.poll() is not None:  # EOF: process died
+            raise RuntimeError(
+                "coordinator failed to start on port %d (exit %s)"
+                % (port, proc.poll()))
+        # other lines (e.g. "recovered") just precede "listening"
+    proc.kill()
+    raise RuntimeError("coordinator did not start within 10s")
+
+
+class CoordinatorClient:
+    def __init__(self, endpoint, worker_id=None, timeout=10.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.worker_id = worker_id or "worker-%d" % os.getpid()
+        self.timeout = timeout
+        self._sock = None
+        self._buf = b""
+
+    # -- wire ---------------------------------------------------------------
+    def _connect(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(self.addr, self.timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._buf = b""
+
+    def call(self, op, **kwargs):
+        req = {"op": op, "worker": self.worker_id}
+        req.update(kwargs)
+        payload = (json.dumps(req) + "\n").encode()
+        for attempt in range(3):
+            try:
+                self._connect()
+                self._sock.sendall(payload)
+                while b"\n" not in self._buf:
+                    chunk = self._sock.recv(65536)
+                    if not chunk:
+                        raise ConnectionError("coordinator closed connection")
+                    self._buf += chunk
+                line, self._buf = self._buf.split(b"\n", 1)
+                return json.loads(line)
+            except (OSError, ConnectionError, json.JSONDecodeError):
+                self.close()
+                if attempt == 2:
+                    raise
+                time.sleep(0.2 * (attempt + 1))
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # -- API ----------------------------------------------------------------
+    def set_dataset(self, chunks, chunks_per_task=None):
+        from paddle_tpu.utils import flags
+
+        per = chunks_per_task or flags.get_flag("num_shards_per_task")
+        return self.call("set_dataset", chunks=list(chunks),
+                         chunks_per_task=per)
+
+    def get_task(self, pass_id=None):
+        """Returns (task_id, chunks), "retry" (all tasks pending on other
+        workers), "pass_done" (requested pass rolled over), or None (no
+        dataset)."""
+        kwargs = {} if pass_id is None else {"pass": pass_id}
+        resp = self.call("get_task", **kwargs)
+        if not resp.get("ok"):
+            if resp.get("retry"):
+                return "retry"
+            if resp.get("error") == "pass done":
+                return "pass_done"
+            return None
+        return resp["task_id"], resp["chunks"]
+
+    def task_finished(self, task_id):
+        return self.call("task_finished", task_id=task_id)
+
+    def task_failed(self, task_id):
+        return self.call("task_failed", task_id=task_id)
+
+    def register(self, ttl=30.0):
+        return self.call("register", ttl=ttl)
+
+    def heartbeat(self, ttl=30.0):
+        return self.call("heartbeat", ttl=ttl)
+
+    def workers(self):
+        return self.call("workers").get("workers", [])
+
+    def request_save_model(self, ttl=60.0):
+        """True iff this worker wins the save election (exactly one does
+        per ttl window — reference RequestSaveModel semantics)."""
+        return bool(self.call("request_save_model", ttl=ttl).get("elected"))
+
+    def status(self):
+        return self.call("status")
+
+    # -- reader integration --------------------------------------------------
+    def task_reader(self, chunk_to_samples, max_retries=1000):
+        """A reader() pulling tasks until the pass drains.
+        ``chunk_to_samples(chunk) -> iterable of samples`` loads one chunk
+        (recordio-shard parity). Failures inside a task report task_failed
+        so the chunk requeues elsewhere."""
+
+        def reader():
+            # one reader() iteration == one pass over the dataset
+            pass_id = self.status().get("pass", 0)
+            retries = 0
+            while True:
+                task = self.get_task(pass_id=pass_id)
+                if task is None or task == "pass_done":
+                    return
+                if task == "retry":
+                    retries += 1
+                    if retries > max_retries:
+                        return
+                    time.sleep(0.1)
+                    continue
+                retries = 0  # only *consecutive* retries should give up
+                task_id, chunks = task
+                try:
+                    for chunk in chunks:
+                        for sample in chunk_to_samples(chunk):
+                            yield sample
+                except Exception:
+                    logger.exception("task %s failed; reporting", task_id)
+                    self.task_failed(task_id)
+                    continue
+                self.task_finished(task_id)
+
+        return reader

@@ -1,0 +1,37 @@
+"""Multi-host initialization (DCN side).
+
+Replaces the reference's cluster bring-up (trainer_id/num_gradient_servers
+gflags + pserver discovery via etcd) with jax.distributed: one line
+initializes the process group over DCN and the same pjit program then spans
+all hosts — gradient exchange stays on XLA collectives (ICI within a slice,
+DCN across slices), with no user-visible transport code (SURVEY.md §2.4
+communication-backend mapping).
+"""
+
+import os
+
+from paddle_tpu.utils import flags
+from paddle_tpu.utils.logger import logger
+
+
+def initialize_multihost(coordinator_address=None, num_processes=None,
+                         process_id=None):
+    """Initialize jax.distributed from args/env/flags. Safe to call when
+    single-host (no-op). Env parity: PADDLE_TPU_TRAINER_ID ≙ --trainer_id."""
+    import jax
+
+    num_processes = num_processes or int(os.environ.get("PADDLE_TPU_NUM_HOSTS", "1"))
+    if num_processes <= 1 and coordinator_address is None:
+        logger.info("single-host run; jax.distributed not initialized")
+        return False
+    process_id = (process_id if process_id is not None
+                  else flags.get_flag("trainer_id"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info("jax.distributed initialized: process %d/%d, %d local / %d "
+                "global devices", process_id, num_processes,
+                jax.local_device_count(), jax.device_count())
+    return True

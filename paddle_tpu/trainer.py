@@ -115,10 +115,7 @@ class SGD:
             self._eval_step = jax.jit(eval_step)
 
         # device-resident training state
-        t, s, st = split(self.parameters.as_dict())
-        self._trainable = {k: jnp.asarray(v) for k, v in t.items()}
-        self._static = {k: jnp.asarray(v) for k, v in s.items()}
-        self._state = {k: jnp.asarray(v) for k, v in st.items()}
+        self._materialize_device_state()
         self._opt_state = optimizer.init_state(self._trainable)
         self._rng = jax.random.PRNGKey(flags.get_flag("seed") or 0)
         self._step_count = 0
@@ -187,6 +184,15 @@ class SGD:
             0, total_cost / max(n_batches, 1), metrics)
 
     # -- state sync ---------------------------------------------------------
+    def _materialize_device_state(self):
+        """Stage host Parameters into device arrays, partitioned into
+        trainable/static/running-state (single point: __prepare__ and
+        checkpoint restore both go through here)."""
+        t, s, st = self._split(self.parameters.as_dict())
+        self._trainable = {k: jnp.asarray(v) for k, v in t.items()}
+        self._static = {k: jnp.asarray(v) for k, v in s.items()}
+        self._state = {k: jnp.asarray(v) for k, v in st.items()}
+
     def _sync_back(self):
         """Copy device training state back into the Parameters object so
         save/inspect sees current values (v2's gm<->parameters append)."""
@@ -196,6 +202,47 @@ class SGD:
     def save_parameter_to_tar(self, f):
         self._sync_back()
         self.parameters.to_tar(f)
+
+    # -- checkpoint/resume (pserver doCheckpoint + ParamUtil parity) --------
+    def save_checkpoint(self, directory, pass_id=0, keep=3,
+                        coordinator=None):
+        """Durable checkpoint of parameters + optimizer state. With a
+        ``coordinator`` client, participates in the save election so exactly
+        one worker writes (reference: RequestSaveModel)."""
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        if coordinator is not None and not coordinator.request_save_model():
+            return None
+        self._sync_back()
+        return ckpt.save_checkpoint(
+            directory, self.parameters, opt_state=jax.device_get(self._opt_state),
+            step=self._step_count, pass_id=pass_id, keep=keep)
+
+    def restore_checkpoint(self, directory_or_path):
+        """Resume parameters + optimizer state from the newest valid
+        checkpoint; returns the meta dict (or None if nothing found)."""
+        import os
+
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        path = directory_or_path
+        if os.path.isdir(path) and not os.path.exists(
+                os.path.join(path, "meta.json")):
+            path = ckpt.latest_checkpoint(path)
+            if path is None:
+                return None
+        params, opt_flat, meta = ckpt.load_checkpoint(path)
+        for name in params.names():
+            if name in self.parameters:
+                self.parameters.set(name, params.get(name))
+        self._materialize_device_state()
+        if opt_flat is not None:
+            template = self.optimizer.init_state(self._trainable)
+            self._opt_state = jax.tree_util.tree_map(
+                jnp.asarray,
+                ckpt.unflatten_state(template, opt_flat))
+        self._step_count = int(meta.get("step", 0))
+        return meta
 
 
 def default_event_handler(evt):

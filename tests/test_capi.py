@@ -1,0 +1,75 @@
+"""C inference API tests: build the shared lib + a real C client program and
+run it against a saved model (reference pattern: paddle/capi/tests +
+examples/model_inference run as part of CI)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI_DIR = os.path.join(REPO, "paddle_tpu", "capi")
+
+
+def _build():
+    subprocess.run(["make", "-C", CAPI_DIR], check=True, capture_output=True)
+    subprocess.run(["make", "-C", CAPI_DIR, "example", "CC=gcc"], check=True,
+                   capture_output=True)
+
+
+@pytest.fixture(scope="module")
+def capi_example(tmp_path_factory):
+    _build()
+    tmp = tmp_path_factory.mktemp("capi")
+    params_tar = str(tmp / "params.tar")
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.vision import mlp
+    from paddle_tpu.parameters import Parameters
+
+    reset_name_counters()
+    out = mlp()
+    params = Parameters.create(out)
+    with open(params_tar, "wb") as f:
+        params.to_tar(f)
+    return params_tar, params, out
+
+
+def test_c_program_runs_inference(capi_example):
+    params_tar, params, out_layer = capi_example
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["LD_LIBRARY_PATH"] = CAPI_DIR
+    proc = subprocess.run(
+        [os.path.join(CAPI_DIR, "examples", "infer_dense"),
+         "paddle_tpu.models.vision:mlp", params_tar, "784"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "C-API OK" in proc.stdout
+    # C output must equal the Python inference on the same input
+    row = [0.1 * (i % 10) for i in range(784)]
+    import paddle_tpu as paddle
+
+    expected = paddle.inference.infer(
+        out_layer, params, [(np.asarray(row, np.float32),)])
+    out_line = [l for l in proc.stdout.splitlines() if l.startswith("output")][0]
+    got = np.array([float(v) for v in out_line.split(":")[1].split()])
+    np.testing.assert_allclose(got, expected[0][:len(got)], rtol=1e-4)
+
+
+def test_c_program_reports_bad_builder(capi_example):
+    params_tar, _, _ = capi_example
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["LD_LIBRARY_PATH"] = CAPI_DIR
+    proc = subprocess.run(
+        [os.path.join(CAPI_DIR, "examples", "infer_dense"),
+         "no.such.module:nope", params_tar, "784"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert proc.returncode != 0
+    assert "No module named" in proc.stderr

@@ -1,0 +1,84 @@
+"""CLI launcher tests (`paddle train` surface parity, TrainerMain.cpp jobs)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG = '''
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import layer as L, data_type as dt, activation as A
+from paddle_tpu import optimizer as opt
+
+batch_size = 16
+
+def cost():
+    x = L.data(name="x", type=dt.dense_vector(6))
+    y = L.data(name="y", type=dt.integer_value(3))
+    h = L.fc(input=x, size=12, act=A.Tanh())
+    out = L.fc(input=h, size=3)
+    return L.classification_cost(input=out, label=y)
+
+def optimizer():
+    return opt.Momentum(learning_rate=0.1, momentum=0.9)
+
+def _data(n, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        W = rng.randn(6, 3)
+        for _ in range(n):
+            x = rng.randn(6).astype(np.float32)
+            yield x, int(np.argmax(x @ W))
+    return reader
+
+def train_reader():
+    return _data(128)
+
+def test_reader():
+    return _data(48)
+'''
+
+
+def _run_cli(args, timeout=300):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["PADDLE_TPU_LOG_LEVEL"] = "WARNING"
+    return subprocess.run([sys.executable, "-m", "paddle_tpu.cli"] + args,
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout, cwd=REPO)
+
+
+@pytest.fixture(scope="module")
+def config_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("cli") / "config.py"
+    path.write_text(CONFIG)
+    return str(path)
+
+
+def test_cli_train_and_checkpoint(config_file, tmp_path):
+    save_dir = str(tmp_path / "ckpts")
+    proc = _run_cli(["train", "--config", config_file, "--num-passes", "2",
+                     "--save-dir", save_dir])
+    assert proc.returncode == 0, proc.stderr
+    assert "test cost=" in proc.stdout
+    assert any(d.startswith("pass-") for d in os.listdir(save_dir))
+
+
+def test_cli_time_job(config_file):
+    proc = _run_cli(["time", "--config", config_file, "--iters", "3"])
+    assert proc.returncode == 0, proc.stderr
+    stats = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert stats["ms_per_batch"] > 0
+
+
+def test_cli_checkgrad_job(config_file):
+    proc = _run_cli(["checkgrad", "--config", config_file])
+    assert proc.returncode == 0, proc.stderr
+    assert "checkgrad PASSED" in proc.stdout
