@@ -15,8 +15,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-jax.config.update("jax_enable_x64", True)
-
 from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
 from paddle_tpu.topology import Topology
 
@@ -35,6 +33,9 @@ def check_layer_grad(output_node, feed, check_inputs=True, eps=1e-5,
                      mode="test"):
     """Numeric-vs-analytic gradient check on every parameter (and optionally
     every dense float input) of the subgraph ending at ``output_node``."""
+    # x64 only while checking — never as an import side effect on the
+    # float32 training stack.
+    jax.config.update("jax_enable_x64", True)
     topo = Topology(output_node)
     params = to_f64(topo.init_params(jax.random.PRNGKey(seed), dtype=jnp.float64))
     feed = to_f64(feed)

@@ -28,17 +28,28 @@ def spawn_coordinator(port, snapshot_path="", task_timeout=600.0,
         [COORDINATOR_BIN, str(port), snapshot_path, str(task_timeout),
          str(failure_max)],
         stderr=subprocess.PIPE)
-    # wait for the listening line; surface startup failures (e.g. bind)
+    # wait for the listening line; surface startup failures (e.g. bind).
+    # poll stderr with a deadline — readline() alone could block forever on
+    # a wedged binary that emits nothing.
+    import selectors
+
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stderr, selectors.EVENT_READ)
     deadline = time.time() + 10
-    while time.time() < deadline:
-        line = proc.stderr.readline().decode()
-        if "listening" in line:
-            return proc
-        if line == "" or proc.poll() is not None:  # EOF: process died
-            raise RuntimeError(
-                "coordinator failed to start on port %d (exit %s)"
-                % (port, proc.poll()))
-        # other lines (e.g. "recovered") just precede "listening"
+    try:
+        while time.time() < deadline:
+            if not sel.select(timeout=max(0.0, deadline - time.time())):
+                break  # deadline hit with no output
+            line = proc.stderr.readline().decode()
+            if "listening" in line:
+                return proc
+            if line == "" or proc.poll() is not None:  # EOF: process died
+                raise RuntimeError(
+                    "coordinator failed to start on port %d (exit %s)"
+                    % (port, proc.poll()))
+            # other lines (e.g. "recovered") just precede "listening"
+    finally:
+        sel.close()
     proc.kill()
     raise RuntimeError("coordinator did not start within 10s")
 

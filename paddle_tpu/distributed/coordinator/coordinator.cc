@@ -217,7 +217,9 @@ class Service {
         Task task = it->second;
         it = pending_.erase(it);
         task.owner.clear();
-        todo_.push_back(task);  // requeue (timeout treated as failure-lite)
+        task.failures++;  // timeouts count toward the poison cap (:336→:308)
+        if (task.failures >= failure_max_) failed_.push_back(task);
+        else todo_.push_back(task);
         dirty_ = true;
       } else {
         ++it;

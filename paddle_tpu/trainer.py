@@ -232,9 +232,23 @@ class SGD:
             if path is None:
                 return None
         params, opt_flat, meta = ckpt.load_checkpoint(path)
+        restored, skipped = 0, []
         for name in params.names():
             if name in self.parameters:
                 self.parameters.set(name, params.get(name))
+                restored += 1
+            else:
+                skipped.append(name)
+        if restored == 0:
+            raise ValueError(
+                "checkpoint %s shares no parameter names with this model "
+                "(checkpoint has %s)" % (path, sorted(params.names())[:8]))
+        if skipped:
+            from paddle_tpu.utils.logger import logger
+
+            logger.warning(
+                "restore_checkpoint: %d checkpoint parameter(s) not in "
+                "model, skipped: %s", len(skipped), skipped[:8])
         self._materialize_device_state()
         if opt_flat is not None:
             template = self.optimizer.init_state(self._trainable)
