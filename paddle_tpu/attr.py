@@ -71,3 +71,7 @@ class ExtraAttr:
 
 
 ExtraLayerAttribute = ExtraAttr
+
+# v2 short aliases (reference: python/paddle/v2/attr.py — Param/Extra)
+Param = ParamAttr
+Extra = ExtraAttr

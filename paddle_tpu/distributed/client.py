@@ -35,7 +35,7 @@ def spawn_coordinator(port, snapshot_path="", task_timeout=600.0,
 
     sel = selectors.DefaultSelector()
     sel.register(proc.stderr, selectors.EVENT_READ)
-    deadline = time.time() + 10
+    deadline = time.time() + 60
     try:
         while time.time() < deadline:
             if not sel.select(timeout=max(0.0, deadline - time.time())):
@@ -51,7 +51,7 @@ def spawn_coordinator(port, snapshot_path="", task_timeout=600.0,
     finally:
         sel.close()
     proc.kill()
-    raise RuntimeError("coordinator did not start within 10s")
+    raise RuntimeError("coordinator did not start within 60s")
 
 
 class CoordinatorClient:

@@ -105,6 +105,8 @@ from paddle_tpu.layer.rnn_group import (
 from paddle_tpu.layer.mixed import (
     BaseProjection,
     context_projection,
+    conv_operator,
+    conv_projection,
     dotmul_operator,
     dotmul_projection,
     full_matrix_projection,
@@ -114,9 +116,30 @@ from paddle_tpu.layer.mixed import (
     table_projection,
     trans_full_matrix_projection,
 )
+from paddle_tpu.layer.misc import (
+    gated_unit,
+    multiplex,
+    out_prod,
+    prelu,
+    selective_fc,
+    tensor,
+)
+from paddle_tpu.layer.step import gru_step, gru_step_naive, lstm_step
+from paddle_tpu.layer.detection import (
+    cross_channel_norm,
+    detection_output,
+    multibox_loss,
+    priorbox,
+)
 
 # aliases matching v2 naming
 pooling_layer = pooling
 embedding_layer = embedding
 fc_layer = fc
 data_layer = data
+
+# aliases matching the v1 DSL (trainer_config_helpers/layers.py __all__)
+convex_comb = linear_comb          # reference: convex_comb_layer = deprecated
+eos = eos_id                       # reference: eos_layer
+printer = print_layer              # reference: printer_layer
+huber_cost = huber_classification_cost

@@ -40,6 +40,25 @@ def _pair(v):
     return (int(v), int(v))
 
 
+def conv_geometry(input, num_channels, filter_size, stride, padding,
+                  filter_size_y=None, stride_y=None, padding_y=None,
+                  caffe_mode=True):
+    """Shared conv geometry parsing: returns (c, h, w, fh, fw, sh, sw, ph,
+    pw, oh, ow). One place for the *_y-override and out-size rules used by
+    img_conv, conv_projection and conv_operator (cf. config_parser.py
+    conv geometry flow)."""
+    c, h, w = _img_shape(input, num_channels)
+    fh = int(filter_size_y if filter_size_y is not None else _pair(filter_size)[0])
+    fw = _pair(filter_size)[1]
+    sh = int(stride_y if stride_y is not None else _pair(stride)[0])
+    sw = _pair(stride)[1]
+    ph = int(padding_y if padding_y is not None else _pair(padding)[0])
+    pw = _pair(padding)[1]
+    oh = conv_ops.out_size(h, fh, sh, ph, caffe_mode)
+    ow = conv_ops.out_size(w, fw, sw, pw, caffe_mode)
+    return c, h, w, fh, fw, sh, sw, ph, pw, oh, ow
+
+
 def _img_shape(node, num_channels=None):
     """Infer (C, H, W) for a layer input (cf. config_parser geometry flow)."""
     shape = getattr(node, "out_img_shape", None)

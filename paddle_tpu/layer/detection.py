@@ -1,0 +1,277 @@
+"""SSD detection suite: priorbox, cross_channel_norm, multibox_loss,
+detection_output.
+
+Parity targets (reference): PriorBoxLayer (gserver/layers/PriorBox.cpp),
+CrossChannelNormLayer (NormLayer.cpp/CrossChannelNormLayer.cpp),
+MultiBoxLossLayer (MultiBoxLossLayer.cpp), DetectionOutputLayer
+(DetectionOutputLayer.cpp) over DetectionUtil.cpp — the ops live in
+paddle_tpu/ops/detection.py.
+
+TPU-native design: prior boxes are compile-time numpy constants (feature-map
+geometry is static), matching/NMS are fixed-shape masked programs, and
+ground-truth boxes arrive as a padded SequenceBatch of [label, xmin, ymin,
+xmax, ymax, difficult] rows (the reference's variable-length label input).
+Detection output is a fixed [B, keep_top_k, 7] tensor with -1 label padding
+instead of the reference's host-side variable-row matrix.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph import auto_name
+from paddle_tpu.layer.base import (
+    data_of,
+    is_seq,
+    like,
+    make_node,
+    register_layer,
+    weight_spec,
+)
+from paddle_tpu.ops import detection as det_ops
+from paddle_tpu.utils.error import enforce
+
+
+def _make_priors(layer_h, layer_w, image_h, image_w, min_size, max_size,
+                 aspect_ratio, variance, clip=True):
+    """Prior grid as a [P, 8] (box4, var4) numpy constant (reference:
+    PriorBoxLayer::forward loop, PriorBox.cpp — same ordering: per cell,
+    per min_size: min box, sqrt(min*max) box, then flipped aspect ratios)."""
+    min_size = list(np.atleast_1d(min_size).astype(np.float64))
+    max_size = list(np.atleast_1d(max_size).astype(np.float64)) if max_size else []
+    ars = [1.0]
+    for r in np.atleast_1d(aspect_ratio).astype(np.float64):
+        if abs(r - 1.0) < 1e-6:
+            continue
+        ars.extend([float(r), 1.0 / float(r)])
+    step_w = float(image_w) / layer_w
+    step_h = float(image_h) / layer_h
+    rows = []
+    for h in range(layer_h):
+        for w in range(layer_w):
+            cx = (w + 0.5) * step_w
+            cy = (h + 0.5) * step_h
+            for si, ms in enumerate(min_size):
+                sizes = [(ms, ms)]
+                if max_size:
+                    mx = max_size[si]
+                    s = np.sqrt(ms * mx)
+                    sizes.append((s, s))
+                for r in ars[1:]:
+                    sizes.append((ms * np.sqrt(r), ms / np.sqrt(r)))
+                for bw, bh in sizes:
+                    box = [(cx - bw / 2.0) / image_w, (cy - bh / 2.0) / image_h,
+                           (cx + bw / 2.0) / image_w, (cy + bh / 2.0) / image_h]
+                    if clip:
+                        box = [min(max(v, 0.0), 1.0) for v in box]
+                    rows.append(box + list(variance))
+    return np.asarray(rows, np.float32)
+
+
+@register_layer("priorbox")
+def priorbox(input, image, aspect_ratio, variance, min_size, max_size=None,
+             name=None, layer_attr=None):
+    """SSD prior boxes for one feature map (reference: priorbox_layer DSL;
+    PriorBox.cpp). ``input`` is the conv feature map (its height/width set
+    the grid), ``image`` the network input (sets the normalizer). Output is
+    the constant [P, 8] prior table (box + variance per row)."""
+    from paddle_tpu.layer.conv import _img_shape
+
+    _, lh, lw = _img_shape(input)
+    _, ih, iw = _img_shape(image)
+    priors = _make_priors(lh, lw, ih, iw, min_size, max_size, aspect_ratio,
+                          variance)
+    num_priors = priors.shape[0]
+    table = jnp.asarray(priors)
+
+    def forward(params, values, ctx):
+        return table
+
+    node = make_node("priorbox", forward, [input, image], name=name,
+                     size=num_priors * 8, layer_attr=layer_attr)
+    node.num_priors = num_priors
+    return node
+
+
+@register_layer("cross_channel_norm")
+def cross_channel_norm(input, name=None, param_attr=None, layer_attr=None):
+    """L2-normalize across channels at each spatial position, with one
+    learned scale per channel (reference: CrossChannelNormLayer.cpp;
+    cross_channel_norm_layer DSL — the SSD conv4_3 normalizer)."""
+    from paddle_tpu.layer.conv import _img_shape
+
+    c, h, w = _img_shape(input)
+    name = name or auto_name("cross_channel_norm")
+    wspec = weight_spec(name, 0, (c,), param_attr, fan_in=1)
+
+    def forward(params, values, ctx):
+        x = data_of(values[0]).reshape(-1, c, h * w)
+        norm = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True) + 1e-12)
+        y = x / norm * params[wspec.name][None, :, None]
+        return like(values[0], y.reshape(-1, c * h * w))
+
+    node = make_node("cross_channel_norm", forward, [input], name=name,
+                     size=input.size, param_specs=[wspec],
+                     layer_attr=layer_attr)
+    node.out_img_shape = (c, h, w)
+    return node
+
+
+@register_layer("multibox_loss")
+def multibox_loss(input_loc, input_conf, priorbox, label, num_classes,
+                  overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+                  background_id=0, name=None, layer_attr=None):
+    """SSD training loss: smooth-L1 localization + softmax confidence with
+    hard negative mining (reference: MultiBoxLossLayer.cpp;
+    multibox_loss_layer DSL).
+
+    ``input_loc``/``input_conf``: per-feature-map prediction layers (lists
+    ok), flattened per sample to [P*4] / [P*num_classes] in prior order.
+    ``priorbox``: priorbox layer(s). ``label``: SequenceBatch of ground
+    truth rows [label, xmin, ymin, xmax, ymax, difficult] per instance.
+    Output: per-sample cost [B]."""
+    from paddle_tpu.layer.base import to_list
+
+    loc_layers = to_list(input_loc)
+    conf_layers = to_list(input_conf)
+    prior_layers = to_list(priorbox)
+    name = name or auto_name("multibox_loss")
+    inputs = [label] + prior_layers + loc_layers + conf_layers
+    n_prior = len(prior_layers)
+    n_loc = len(loc_layers)
+
+    def forward(params, values, ctx):
+        gt = values[0]
+        enforce(is_seq(gt), "multibox_loss label must be a sequence")
+        prior_tabs = values[1: 1 + n_prior]
+        locs = values[1 + n_prior: 1 + n_prior + n_loc]
+        confs = values[1 + n_prior + n_loc:]
+        priors_all = jnp.concatenate(prior_tabs, axis=0)        # [P, 8]
+        pbox, pvar = priors_all[:, :4], priors_all[:, 4:]
+        num_p = priors_all.shape[0]
+        loc = jnp.concatenate(
+            [data_of(v).reshape(data_of(v).shape[0], -1, 4) for v in locs],
+            axis=1)                                              # [B, P, 4]
+        conf = jnp.concatenate(
+            [data_of(v).reshape(data_of(v).shape[0], -1, num_classes)
+             for v in confs], axis=1)                            # [B, P, C]
+        enforce(loc.shape[1] == num_p, "loc predictions/prior count mismatch")
+
+        gt_rows = gt.data                                        # [B, G, 6]
+        gt_valid = gt.mask()                                     # [B, G]
+        gt_label = gt_rows[..., 0].astype(jnp.int32)
+        gt_box = gt_rows[..., 1:5]
+
+        def per_sample(loc_b, conf_b, gtb, gtl, gtv):
+            match, match_iou = det_ops.match_priors(pbox, gtb, gtv,
+                                                    overlap_threshold)
+            pos = match >= 0                                     # [P]
+            safe = jnp.clip(match, 0, gtb.shape[0] - 1)
+            target_box = det_ops.encode_box(pbox, pvar,
+                                            jnp.take(gtb, safe, axis=0))
+            # smooth-L1 on positives (reference: smoothL1 loc loss)
+            diff = loc_b - target_box
+            ad = jnp.abs(diff)
+            sl1 = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5).sum(axis=-1)
+            loc_loss = jnp.sum(jnp.where(pos, sl1, 0.0))
+
+            target_cls = jnp.where(pos, jnp.take(gtl, safe), background_id)
+            logp = jax.nn.log_softmax(conf_b, axis=-1)
+            ce = -jnp.take_along_axis(logp, target_cls[:, None], axis=1)[:, 0]
+            # hard negative mining: top (ratio * num_pos) background losses
+            num_pos = jnp.sum(pos)
+            num_neg = jnp.minimum(
+                (neg_pos_ratio * num_pos).astype(jnp.int32),
+                num_p - num_pos)
+            # ambiguous priors (best IoU > neg_overlap) are excluded from
+            # the negative pool (reference: MultiBoxLossLayer.cpp mines
+            # negatives only among priors below the neg_overlap cutoff)
+            neg_ok = ~pos & (match_iou <= neg_overlap)
+            neg_score = jnp.where(neg_ok, ce, -jnp.inf)
+            order = jnp.argsort(-neg_score)
+            rank = jnp.argsort(order)
+            neg_sel = rank < num_neg
+            conf_loss = jnp.sum(jnp.where(pos | neg_sel, ce, 0.0))
+            denom = jnp.maximum(num_pos.astype(ce.dtype), 1.0)
+            return (loc_loss + conf_loss) / denom
+
+        return jax.vmap(per_sample)(loc, conf, gt_box, gt_label, gt_valid)
+
+    return make_node("multibox_loss", forward, inputs, name=name, size=1,
+                     layer_attr=layer_attr)
+
+
+@register_layer("detection_output")
+def detection_output(input_loc, input_conf, priorbox, num_classes,
+                     nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                     confidence_threshold=0.01, background_id=0, name=None,
+                     layer_attr=None):
+    """SSD inference head: decode boxes, per-class NMS, keep the top
+    detections (reference: DetectionOutputLayer.cpp; detection_output_layer
+    DSL). Output [B, keep_top_k, 7]: [image_idx, label, score, xmin, ymin,
+    xmax, ymax], label = -1 on padding rows (the reference emits variable
+    row counts host-side; fixed shape + sentinel is the XLA form)."""
+    from paddle_tpu.layer.base import to_list
+
+    loc_layers = to_list(input_loc)
+    conf_layers = to_list(input_conf)
+    prior_layers = to_list(priorbox)
+    name = name or auto_name("detection_output")
+    inputs = prior_layers + loc_layers + conf_layers
+    n_prior = len(prior_layers)
+    n_loc = len(loc_layers)
+
+    def forward(params, values, ctx):
+        prior_tabs = values[:n_prior]
+        locs = values[n_prior: n_prior + n_loc]
+        confs = values[n_prior + n_loc:]
+        priors_all = jnp.concatenate(prior_tabs, axis=0)
+        pbox, pvar = priors_all[:, :4], priors_all[:, 4:]
+        loc = jnp.concatenate(
+            [data_of(v).reshape(data_of(v).shape[0], -1, 4) for v in locs],
+            axis=1)
+        conf = jnp.concatenate(
+            [data_of(v).reshape(data_of(v).shape[0], -1, num_classes)
+             for v in confs], axis=1)
+        probs = jax.nn.softmax(conf, axis=-1)                   # [B, P, C]
+
+        def per_sample(b_idx, loc_b, prob_b):
+            boxes = det_ops.decode_box(pbox, pvar, loc_b)       # [P, 4]
+            outs = []
+            for cls in range(num_classes):
+                if cls == background_id:
+                    continue
+                score = prob_b[:, cls]
+                valid = score > confidence_threshold
+                idx, keep = det_ops.nms(boxes, score, valid, nms_threshold,
+                                        min(nms_top_k, boxes.shape[0]))
+                outs.append((jnp.take(boxes, idx, axis=0),
+                             jnp.take(score, idx), keep,
+                             jnp.full(idx.shape, cls, jnp.int32)))
+            all_boxes = jnp.concatenate([o[0] for o in outs], axis=0)
+            all_scores = jnp.concatenate([o[1] for o in outs])
+            all_keep = jnp.concatenate([o[2] for o in outs])
+            all_cls = jnp.concatenate([o[3] for o in outs])
+            s = jnp.where(all_keep, all_scores, -1.0)
+            k_out = min(keep_top_k, int(all_scores.shape[0]))
+            top = jnp.argsort(-s)[:k_out]
+            kmask = jnp.take(all_keep, top)
+            row = jnp.concatenate([
+                jnp.full((k_out, 1), b_idx, jnp.float32),
+                jnp.where(kmask, jnp.take(all_cls, top), -1)[:, None]
+                .astype(jnp.float32),
+                jnp.take(all_scores, top)[:, None],
+                jnp.take(all_boxes, top, axis=0),
+            ], axis=1)
+            if k_out < keep_top_k:
+                pad = jnp.full((keep_top_k - k_out, 7), -1.0, jnp.float32)
+                row = jnp.concatenate([row, pad], axis=0)
+            return row
+
+        batch = loc.shape[0]
+        rows = jax.vmap(per_sample)(jnp.arange(batch, dtype=jnp.float32),
+                                    loc, probs)
+        return rows
+
+    return make_node("detection_output", forward, inputs, name=name,
+                     size=keep_top_k * 7, layer_attr=layer_attr)

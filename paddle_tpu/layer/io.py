@@ -13,7 +13,10 @@ from paddle_tpu.utils.error import enforce
 
 
 @register_layer("data")
-def data(name, type, layer_attr=None):
+def data(name, type, height=None, width=None, layer_attr=None):
+    """``height``/``width`` declare image geometry for downstream conv /
+    detection layers (reference: v2 layer.data height/width args feeding
+    LayerConfig.height/width)."""
     enforce(isinstance(type, InputType), "layer.data 'type' must be an InputType")
 
     def forward(params, inputs, ctx):
@@ -28,4 +31,12 @@ def data(name, type, layer_attr=None):
         seq_level=type.seq_type,
     )
     node.input_type = type
+    if height is not None or width is not None:
+        enforce(height and width,
+                "data %r: height and width must be given together and be "
+                "positive (got height=%r width=%r)" % (name, height, width))
+        channels = type.dim // (height * width)
+        enforce(channels * height * width == type.dim,
+                "data %r: size %d != C*%d*%d" % (name, type.dim, height, width))
+        node.out_img_shape = (channels, height, width)
     return node

@@ -1,0 +1,201 @@
+"""Assorted parametric layers: tensor, selective_fc, out_prod, multiplex,
+prelu, gated_unit.
+
+Parity targets (reference): TensorLayer.cpp, SelectiveFullyConnectedLayer.cpp,
+OuterProdLayer.cpp, MultiplexLayer.cpp, ParameterReluLayer.cpp, and the
+gated_unit_layer DSL composite (trainer_config_helpers/layers.py).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtype import matmul_precision
+from paddle_tpu.graph import auto_name
+from paddle_tpu.layer.base import (
+    bias_spec,
+    data_of,
+    featurewise,
+    finalize,
+    is_seq,
+    like,
+    make_node,
+    mark_activation,
+    register_layer,
+    to_list,
+    weight_spec,
+)
+from paddle_tpu.utils.error import enforce
+
+
+def _mm(a, b):
+    return jnp.matmul(a, b, precision=matmul_precision())
+
+
+@register_layer("tensor")
+def tensor(a, b, size, act=None, name=None, param_attr=None, bias_attr=None,
+           layer_attr=None):
+    """Bilinear tensor product: out_k = a^T W_k b (reference:
+    TensorLayer.cpp — one [a.size, b.size] slice per output unit;
+    tensor_layer DSL). Parameter shape [size, a.size, b.size]."""
+    name = name or auto_name("tensor_layer")
+    wspec = weight_spec(name, 0, (size, a.size, b.size), param_attr,
+                        fan_in=a.size * b.size)
+    bspec = bias_spec(name, (size,), bias_attr)
+
+    def forward(params, values, ctx):
+        x, y = data_of(values[0]), data_of(values[1])
+        w = params[wspec.name]
+        # einsum maps onto batched MXU GEMMs: [B,A] x [K,A,B'] x [B,B'] -> [B,K]
+        out = jnp.einsum("ba,kac,bc->bk", x, w, y,
+                         precision=matmul_precision())
+        if bspec is not None:
+            out = out + params[bspec.name]
+        return finalize(like(values[0], out), act, node.extra_attr, ctx)
+
+    node = make_node("tensor", forward, [a, b], name=name, size=size,
+                     param_specs=[s for s in (wspec, bspec) if s],
+                     layer_attr=layer_attr)
+    return mark_activation(node, act)
+
+
+@register_layer("selective_fc")
+def selective_fc(input, select, size, act=None, name=None, pass_generation=False,
+                 has_selected_colums=True, mul_ratio=0.02, param_attr=None,
+                 bias_attr=None, layer_attr=None):
+    """Selective fully-connected layer (reference:
+    SelectiveFullyConnectedLayer.cpp — computes only the selected output
+    columns). ``select`` holds a 0/1 mask [B, size] (dense form of the
+    reference's sparse selection matrix); None selects every column.
+
+    TPU-native note: the reference switches between sparse per-row GEMV and
+    full GEMM by ``mul_ratio``; on the MXU the full [B,D]x[D,size] GEMM is
+    the fast path, so we always run it and mask — same results, one fused
+    kernel. Weight layout is transposed vs fc ([size, input.size]) to match
+    the reference's checkpoint format (w.getTranspose() in the C++)."""
+    inputs = [input] + ([select] if select is not None else [])
+    name = name or auto_name("selective_fc_layer")
+    wspec = weight_spec(name, 0, (size, input.size), param_attr,
+                        fan_in=input.size)
+    bspec = bias_spec(name, (size,), bias_attr)
+
+    def forward(params, values, ctx):
+        x = data_of(values[0])
+        w = params[wspec.name]
+        out = _mm(x, w.T)
+        if bspec is not None:
+            out = out + params[bspec.name]
+        out = finalize(like(values[0], out), act, node.extra_attr, ctx)
+        if select is not None:
+            # unselected columns are never computed in the reference —
+            # post-activation zeros reproduce that observable state
+            mask = data_of(values[1])
+            out = like(out, data_of(out) * mask.astype(data_of(out).dtype))
+        return out
+
+    node = make_node("selective_fc", forward, inputs, name=name, size=size,
+                     param_specs=[s for s in (wspec, bspec) if s],
+                     layer_attr=layer_attr)
+    return mark_activation(node, act)
+
+
+@register_layer("out_prod")
+def out_prod(input1, input2, name=None, layer_attr=None):
+    """Flattened outer product of two vectors per sample (reference:
+    OuterProdLayer.cpp; out_prod_layer). Output size = size1 * size2."""
+    size = input1.size * input2.size
+
+    def forward(params, values, ctx):
+        x, y = data_of(values[0]), data_of(values[1])
+        out = jnp.einsum("bi,bj->bij", x, y).reshape(x.shape[0], size)
+        return like(values[0], out)
+
+    return make_node("out_prod", forward, [input1, input2], name=name,
+                     size=size, layer_attr=layer_attr)
+
+
+@register_layer("multiplex")
+def multiplex(input, name=None, layer_attr=None):
+    """Per-sample input selection (reference: MultiplexLayer.cpp). input[0]
+    is an integer index layer; row b of the output is row b of
+    input[index[b] + 1]."""
+    inputs = to_list(input)
+    enforce(len(inputs) >= 3, "multiplex needs an index layer + >=2 inputs")
+    size = inputs[1].size
+    for extra in inputs[2:]:
+        enforce(extra.size == size, "multiplex inputs must share size")
+
+    def forward(params, values, ctx):
+        idx = data_of(values[0]).reshape(-1).astype(jnp.int32)
+        stacked = jnp.stack([data_of(v) for v in values[1:]], axis=0)  # [K,B,D]
+        k = stacked.shape[0]
+        idx = jnp.clip(idx, 0, k - 1)
+        out = jnp.take_along_axis(
+            stacked, idx[None, :, None].astype(jnp.int32), axis=0)[0]
+        return like(values[1], out)
+
+    return make_node("multiplex", forward, inputs, name=name, size=size,
+                     layer_attr=layer_attr)
+
+
+@register_layer("prelu")
+def prelu(input, name=None, partial_sum=1, param_attr=None, layer_attr=None):
+    """Parametric ReLU (reference: ParameterReluLayer.cpp; prelu_layer DSL).
+    ``partial_sum`` groups consecutive features sharing one slope:
+    1 = element-wise (size slopes), input.size = one slope for all."""
+    enforce(input.size % partial_sum == 0,
+            "prelu: input.size must be divisible by partial_sum")
+    n_slopes = input.size // partial_sum
+    name = name or auto_name("prelu_layer")
+    wspec = weight_spec(name, 0, (n_slopes,), param_attr, fan_in=n_slopes)
+
+    def forward(params, values, ctx):
+        w = jnp.repeat(params[wspec.name], partial_sum)
+
+        def apply(x):
+            return jnp.where(x > 0, x, x * w)
+
+        return featurewise(apply, values[0])
+
+    return make_node("prelu", forward, [input], name=name, size=input.size,
+                     param_specs=[wspec], layer_attr=layer_attr)
+
+
+@register_layer("gated_unit")
+def gated_unit(input, size, act=None, name=None, gate_attr=None,
+               gate_param_attr=None, gate_bias_attr=True, inproj_attr=None,
+               inproj_param_attr=None, inproj_bias_attr=True,
+               layer_attr=None):
+    """Gated linear unit: act(X·W1) ⊙ σ(X·W2) (reference: gated_unit_layer
+    DSL composite — language-model gating, arXiv:1612.08083).
+    ``inproj_attr``/``gate_attr`` are the ExtraAttrs of the inner projection
+    and gate layers (reference passes them to the two mixed layers —
+    dropout etc. applied per branch before the product)."""
+    from paddle_tpu.activation import to_activation
+    from paddle_tpu.attr import ExtraAttr
+    from paddle_tpu.layer.base import finalize
+
+    name = name or auto_name("gated_unit_layer")
+    wspec = weight_spec(name + ".in", 0, (input.size, size),
+                        inproj_param_attr, fan_in=input.size)
+    bspec = bias_spec(name + ".in", (size,), inproj_bias_attr)
+    gw = weight_spec(name + ".gate", 0, (input.size, size), gate_param_attr,
+                     fan_in=input.size)
+    gb = bias_spec(name + ".gate", (size,), gate_bias_attr)
+    in_extra = ExtraAttr.to_attr(inproj_attr)
+    gate_extra = ExtraAttr.to_attr(gate_attr)
+    a = act or "linear"
+
+    def forward(params, values, ctx):
+        def linear(x, w, b):
+            out = _mm(x, params[w.name])
+            return out + params[b.name] if b is not None else out
+
+        proj = featurewise(lambda x: linear(x, wspec, bspec), values[0])
+        proj = finalize(proj, a, in_extra, ctx)
+        gate = featurewise(lambda x: linear(x, gw, gb), values[0])
+        gate = finalize(gate, "sigmoid", gate_extra, ctx)
+        return like(proj, data_of(proj) * data_of(gate))
+
+    specs = [s for s in (wspec, bspec, gw, gb) if s is not None]
+    return make_node("gated_unit", forward, [input], name=name, size=size,
+                     param_specs=specs, layer_attr=layer_attr)

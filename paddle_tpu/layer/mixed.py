@@ -163,6 +163,82 @@ class context_projection(BaseProjection):
         return SequenceBatch(out, value.lengths)
 
 
+class conv_projection(BaseProjection):
+    """Convolution as a mixed-layer projection (reference: ConvProjection,
+    gserver/layers/ConvProjection.cpp; DSL conv_projection). Owns the filter
+    parameter; output is the flattened NCHW feature map."""
+
+    def __init__(self, input, filter_size, num_filters, num_channels=None,
+                 stride=1, padding=0, groups=1, param_attr=None):
+        from paddle_tpu.layer.conv import conv_geometry
+
+        super(conv_projection, self).__init__(input, None, param_attr)
+        (self.c, self.h, self.w, self.fh, self.fw, self.sh, self.sw,
+         self.ph, self.pw, self.oh, self.ow) = conv_geometry(
+            input, num_channels, filter_size, stride, padding)
+        self.groups = groups
+        self.num_filters = num_filters
+        self.size = num_filters * self.oh * self.ow
+
+    def build(self, layer_name, idx):
+        self.wspec = weight_spec(
+            layer_name, idx,
+            (self.fh, self.fw, self.c // self.groups, self.num_filters),
+            self.param_attr, fan_in=self.c * self.fh * self.fw // self.groups)
+        return [self.wspec]
+
+    def forward(self, params, value, ctx):
+        from paddle_tpu.layer.conv import _to_flat, _to_nhwc
+        from paddle_tpu.ops import conv as conv_ops
+
+        x = _to_nhwc(data_of(value), self.c, self.h, self.w)
+        y = conv_ops.conv2d(x, params[self.wspec.name],
+                            stride=(self.sh, self.sw),
+                            padding=((self.ph, self.ph), (self.pw, self.pw)),
+                            groups=self.groups)
+        return like(value, _to_flat(y))
+
+
+class conv_operator:
+    """Parameter-free convolution of two layer outputs: input[0] is the
+    image, input[1] supplies the filter values (reference: ConvOperator,
+    gserver/layers/ConvOperator.cpp; DSL conv_operator — used for
+    image-pair correlation in mixed layers)."""
+
+    def __init__(self, img, filter, filter_size, num_filters,
+                 num_channels=None, stride=1, padding=0, filter_size_y=None,
+                 stride_y=None, padding_y=None):
+        from paddle_tpu.layer.conv import conv_geometry
+
+        self.inputs = [img, filter]
+        (self.c, self.h, self.w, self.fh, self.fw, self.sh, self.sw,
+         self.ph, self.pw, self.oh, self.ow) = conv_geometry(
+            img, num_channels, filter_size, stride, padding,
+            filter_size_y, stride_y, padding_y)
+        self.num_filters = num_filters
+        self.size = num_filters * self.oh * self.ow
+
+    def forward_op(self, values, ctx):
+        import jax
+
+        from paddle_tpu.layer.conv import _to_flat, _to_nhwc
+        from paddle_tpu.ops import conv as conv_ops
+
+        x = _to_nhwc(data_of(values[0]), self.c, self.h, self.w)
+        # per-sample filters: vmap the conv over the batch
+        filt = data_of(values[1]).reshape(
+            -1, self.num_filters, self.c, self.fh, self.fw
+        ).transpose(0, 3, 4, 2, 1)  # [B, fh, fw, C, K]
+
+        def one(img, k):
+            return conv_ops.conv2d(img[None], k, stride=(self.sh, self.sw),
+                                   padding=((self.ph, self.ph),
+                                            (self.pw, self.pw)))[0]
+
+        y = jax.vmap(one)(x, filt)
+        return like(values[0], _to_flat(y))
+
+
 class dotmul_operator:
     """Parameter-free elementwise product scaled (reference: DotMulOperator)."""
 
@@ -205,7 +281,7 @@ def mixed(size=None, input=None, name=None, act=None, bias_attr=False,
             specs.extend(br.build(name, i))
             graph_inputs.append(br.input)
             branch_slots.append((br, [len(graph_inputs) - 1]))
-        elif isinstance(br, dotmul_operator):
+        elif isinstance(br, (dotmul_operator, conv_operator)):
             idxs = []
             for node_in in br.inputs:
                 graph_inputs.append(node_in)

@@ -173,11 +173,16 @@ class _StepProgram:
         for out in self.outputs:
             visit(out)
 
-        # memories must bind to a layer inside the group by name
-        self.by_name = {n.name: n for n in self.step_order}
+        # memories must bind to a layer inside the group by name; the bound
+        # layer may be off the output path (e.g. a get_output 'state' node
+        # feeding only the next step's memory — lstmemory_unit pattern), so
+        # pull its chain into the step program too
+        all_by_name = {n.name: n for n in state["nodes"]}
         for m in self.memories:
-            enforce(m.memory_of in self.by_name,
+            enforce(m.memory_of in all_by_name,
                     "memory(%r) does not match any layer in the step" % m.memory_of)
+            visit(all_by_name[m.memory_of])
+        self.by_name = {n.name: n for n in self.step_order}
 
         # parameters owned by the group = step-subgraph params
         self.param_specs = []
@@ -290,10 +295,26 @@ def recurrent_group(step, input, reverse=False, name=None, targetInlink=None):
 
 @register_layer("get_output")
 def get_output(input, arg_name=None, name=None):
-    """Expose a non-primary output of a recurrent_group step (reference:
-    GetOutputLayer). arg_name: name of the inner layer to extract."""
+    """Expose a non-primary output of a layer (reference: GetOutputLayer,
+    config_parser.py GetOutputLayer:3037). Two forms:
+
+    * a step-cell aux output (e.g. lstm_step's 'state'): builds a sibling
+      node sharing the cell's inputs whose forward recomputes the cell and
+      returns the aux value — XLA CSEs the duplicate math away;
+    * a recurrent_group inner layer by name (multi-output scan).
+    """
+    aux = getattr(input, "aux_outputs", None)
+    if aux is not None and arg_name in aux:
+        aux_fn, aux_size = aux[arg_name]
+        # carry the cell's param_specs: the aux forward reads the cell's
+        # params, and the cell node itself may be unreachable from here
+        # (Topology dedups shared specs by name)
+        return make_node("get_output", aux_fn, list(input.inputs), name=name,
+                         size=aux_size, param_specs=list(input.param_specs))
     program = getattr(input, "_step_program", None)
-    enforce(program is not None, "get_output expects a recurrent_group layer")
+    enforce(program is not None,
+            "get_output expects a recurrent_group layer or a layer with "
+            "aux output %r" % arg_name)
     enforce(arg_name in program.by_name, "no inner layer named %r" % arg_name)
     inner = program.by_name[arg_name]
 

@@ -1,0 +1,138 @@
+"""Single-step recurrent cells for use inside recurrent_group.
+
+Parity targets (reference): LstmStepLayer (gserver/layers/LstmStepLayer.cpp;
+config_parser.py LstmStepLayer :3013 — bias is the 3 peephole check vectors),
+GruStepLayer (:3103 — owns the [size, 3*size] recurrent weight + 3*size
+bias), and the naive variant. These are the building blocks of
+networks.lstmemory_unit / gru_unit; the full-sequence fused path is
+paddle_tpu/layer/recurrent.py.
+
+The reference exposes the LSTM cell state as a second output read via
+get_output_layer(arg_name='state'); here the node carries ``aux_outputs`` —
+a dict of pure functions over the same inputs — and layer.get_output builds
+a sibling node from one of them (XLA CSEs the recomputation, so this costs
+nothing at runtime).
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.activation import to_activation
+from paddle_tpu.core.dtype import matmul_precision
+from paddle_tpu.graph import auto_name
+from paddle_tpu.layer.base import (
+    bias_spec,
+    data_of,
+    like,
+    make_node,
+    mark_activation,
+    register_layer,
+    weight_spec,
+)
+from paddle_tpu.utils.error import enforce
+
+
+def _mm(a, b):
+    return jnp.matmul(a, b, precision=matmul_precision())
+
+
+@register_layer("lstm_step")
+def lstm_step(input, state, size=None, act=None, name=None, gate_act=None,
+              state_act=None, bias_attr=None, layer_attr=None):
+    """One LSTM step (reference: lstm_step_layer, layers.py:3172;
+    LstmStepLayer.cpp). ``input`` is the 4*size projection
+    W*x_t + W_h*h_{t-1} computed by a preceding mixed/fc layer; ``state``
+    is c_{t-1} (a memory). The bias holds the three peephole check vectors
+    [Wci, Wcf, Wco] (config_parser.py:3033 `create_bias_parameter(bias,
+    size * 3)`). Primary output h_t; aux output 'state' = c_t via
+    layer.get_output."""
+    size = size or state.size
+    enforce(input.size == 4 * size, "lstm_step input.size must be 4*size")
+    enforce(state.size == size, "lstm_step state.size must equal size")
+    name = name or auto_name("lstm_step")
+    bspec = bias_spec(name, (3 * size,), bias_attr
+                      if bias_attr is not None else True)
+    g_act = to_activation(gate_act or "sigmoid").apply
+    s_act = to_activation(state_act or "tanh").apply
+    o_act = to_activation(act or "tanh").apply
+
+    def cell(params, values):
+        gates, c_prev = data_of(values[0]), data_of(values[1])
+        zi, zf, zg, zo = jnp.split(gates, 4, axis=-1)
+        if bspec is not None:
+            pi, pf, po = jnp.split(params[bspec.name], 3, axis=-1)
+        else:
+            pi = pf = po = 0.0
+        i = g_act(zi + c_prev * pi)
+        f = g_act(zf + c_prev * pf)
+        c = f * c_prev + i * s_act(zg)
+        o = g_act(zo + c * po)
+        h = o * o_act(c)
+        return h, c
+
+    def forward(params, values, ctx):
+        h, _ = cell(params, values)
+        return like(values[0], h)
+
+    def state_out(params, values, ctx):
+        _, c = cell(params, values)
+        return like(values[0], c)
+
+    node = make_node("lstm_step", forward, [input, state], name=name,
+                     size=size, param_specs=[bspec] if bspec else [],
+                     layer_attr=layer_attr)
+    node.aux_outputs = {"state": (state_out, size)}
+    return node
+
+
+def _gru_step_impl(layer_type, input, output_mem, size, act, name, gate_act,
+                   bias_attr, param_attr, layer_attr):
+    size = size or output_mem.size
+    enforce(input.size == 3 * size, "%s input.size must be 3*size" % layer_type)
+    enforce(output_mem.size == size, "%s output_mem.size must equal size" % layer_type)
+    name = name or auto_name(layer_type)
+    # reference GruStepLayer owns one [size, 3*size] recurrent weight
+    # (config_parser.py:3121) laid out [update, reset, candidate]
+    wspec = weight_spec(name, 0, (size, 3 * size), param_attr, fan_in=size)
+    bspec = bias_spec(name, (3 * size,), bias_attr
+                      if bias_attr is not None else True)
+    g_act = to_activation(gate_act or "sigmoid").apply
+    s_act = to_activation(act or "tanh").apply
+
+    def forward(params, values, ctx):
+        xproj, h_prev = data_of(values[0]), data_of(values[1])
+        if bspec is not None:
+            xproj = xproj + params[bspec.name]
+        xu, xr, xc = jnp.split(xproj, 3, axis=-1)
+        w = params[wspec.name]
+        w_rz, w_c = w[:, : 2 * size], w[:, 2 * size:]
+        zu_r, zr_r = jnp.split(_mm(h_prev, w_rz), 2, axis=-1)
+        u = g_act(xu + zu_r)
+        r = g_act(xr + zr_r)
+        c = s_act(xc + _mm(r * h_prev, w_c))
+        h = u * h_prev + (1.0 - u) * c
+        return like(values[0], h)
+
+    specs = [s for s in (wspec, bspec) if s is not None]
+    return make_node(layer_type, forward, [input, output_mem], name=name,
+                     size=size, param_specs=specs, layer_attr=layer_attr)
+
+
+@register_layer("gru_step")
+def gru_step(input, output_mem, size=None, act=None, name=None,
+             gate_act=None, bias_attr=None, param_attr=None, layer_attr=None):
+    """One GRU step (reference: gru_step_layer; GruStepLayer
+    config_parser.py:3103). ``input`` is the 3*size projection of x_t;
+    the recurrent weight lives in this layer. Gate math matches
+    ops.rnn.gru_step (hl_gpu_gru.cuh parity): h = u*h_prev + (1-u)*cand."""
+    return _gru_step_impl("gru_step", input, output_mem, size, act, name,
+                          gate_act, bias_attr, param_attr, layer_attr)
+
+
+@register_layer("gru_step_naive")
+def gru_step_naive(input, output_mem, size=None, act=None, name=None,
+                   gate_act=None, bias_attr=None, param_attr=None,
+                   layer_attr=None):
+    """Non-fused reference variant (gru_step_naive_layer — same math built
+    from primitive layers; on TPU both compile to the same XLA program)."""
+    return _gru_step_impl("gru_step_naive", input, output_mem, size, act,
+                          name, gate_act, bias_attr, param_attr, layer_attr)

@@ -1,0 +1,119 @@
+"""Bounding-box kernels for the SSD detection suite.
+
+Replaces the reference's DetectionUtil.cpp (gserver/layers/DetectionUtil.cpp:
+encodeBBoxWithVar/decodeBBoxWithVar, jaccardOverlap, matchBBox, applyNMSFast,
+getDetectionIndices). All fixed-shape jnp programs: variable-count boxes are
+carried as padded arrays + validity masks, NMS is an O(K*N) masked
+suppression loop under lax.fori_loop — XLA-friendly, no host round-trips.
+
+Box format: [xmin, ymin, xmax, ymax], normalized to [0, 1].
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+_EPS = 1e-8
+
+
+def bbox_area(boxes):
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0], 0.0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1], 0.0)
+    return w * h
+
+
+def jaccard_overlap(a, b):
+    """IoU matrix between two box sets: a [N, 4], b [M, 4] -> [N, M]
+    (reference: jaccardOverlap, DetectionUtil.cpp)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = bbox_area(a)[:, None] + bbox_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, _EPS)
+
+
+def encode_box(prior, variance, gt):
+    """Encode ground-truth vs prior with variance (reference:
+    encodeBBoxWithVar). prior/gt [..., 4], variance [..., 4]."""
+    pw = jnp.maximum(prior[..., 2] - prior[..., 0], _EPS)
+    ph = jnp.maximum(prior[..., 3] - prior[..., 1], _EPS)
+    pcx = (prior[..., 0] + prior[..., 2]) * 0.5
+    pcy = (prior[..., 1] + prior[..., 3]) * 0.5
+    gw = jnp.maximum(gt[..., 2] - gt[..., 0], _EPS)
+    gh = jnp.maximum(gt[..., 3] - gt[..., 1], _EPS)
+    gcx = (gt[..., 0] + gt[..., 2]) * 0.5
+    gcy = (gt[..., 1] + gt[..., 3]) * 0.5
+    return jnp.stack([
+        (gcx - pcx) / pw / variance[..., 0],
+        (gcy - pcy) / ph / variance[..., 1],
+        jnp.log(gw / pw) / variance[..., 2],
+        jnp.log(gh / ph) / variance[..., 3],
+    ], axis=-1)
+
+
+def decode_box(prior, variance, loc):
+    """Inverse of encode_box (reference: decodeBBoxWithVar)."""
+    pw = jnp.maximum(prior[..., 2] - prior[..., 0], _EPS)
+    ph = jnp.maximum(prior[..., 3] - prior[..., 1], _EPS)
+    pcx = (prior[..., 0] + prior[..., 2]) * 0.5
+    pcy = (prior[..., 1] + prior[..., 3]) * 0.5
+    cx = loc[..., 0] * variance[..., 0] * pw + pcx
+    cy = loc[..., 1] * variance[..., 1] * ph + pcy
+    w = jnp.exp(jnp.clip(loc[..., 2] * variance[..., 2], -10.0, 10.0)) * pw
+    h = jnp.exp(jnp.clip(loc[..., 3] * variance[..., 3], -10.0, 10.0)) * ph
+    return jnp.clip(jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                               cx + w * 0.5, cy + h * 0.5], axis=-1), 0.0, 1.0)
+
+
+def match_priors(priors, gt_boxes, gt_valid, overlap_threshold):
+    """Bipartite + per-prediction matching (reference: matchBBox,
+    DetectionUtil.cpp). priors [P, 4]; gt_boxes [G, 4]; gt_valid [G] bool.
+
+    Returns (match_idx [P] int32 — gt index or -1, match_iou [P]).
+    Every gt gets its best prior (bipartite step); remaining priors match
+    their best gt if IoU > threshold.
+    """
+    iou = jaccard_overlap(priors, gt_boxes)           # [P, G]
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)       # [P]
+    best_gt_iou = jnp.max(iou, axis=1)                         # [P]
+    match = jnp.where(best_gt_iou > overlap_threshold, best_gt, -1)
+    # bipartite: each valid gt claims its single best prior
+    best_prior = jnp.argmax(iou, axis=0).astype(jnp.int32)     # [G]
+    gt_ids = jnp.arange(gt_boxes.shape[0], dtype=jnp.int32)
+    claimed = jnp.where(gt_valid, best_prior, -1)
+    match = match.at[jnp.clip(claimed, 0, priors.shape[0] - 1)].set(
+        jnp.where(gt_valid, gt_ids, match[jnp.clip(claimed, 0, priors.shape[0] - 1)]))
+    match_iou = jnp.where(match >= 0,
+                          jnp.take_along_axis(
+                              iou, jnp.clip(match, 0, iou.shape[1] - 1)[:, None],
+                              axis=1)[:, 0],
+                          best_gt_iou)
+    return match, match_iou
+
+
+def nms(boxes, scores, valid, iou_threshold, top_k):
+    """Greedy NMS with fixed output size (reference: applyNMSFast).
+    boxes [N, 4], scores [N], valid [N] bool. Returns (indices [top_k],
+    keep_mask [top_k]) — indices into the input, score-ordered.
+    """
+    neg = jnp.finfo(scores.dtype).min
+    s = jnp.where(valid, scores, neg)
+    order = jnp.argsort(-s)
+    boxes_o = jnp.take(boxes, order, axis=0)
+    valid_o = jnp.take(valid, order)
+    iou = jaccard_overlap(boxes_o, boxes_o)
+
+    n = boxes.shape[0]
+    k = min(top_k, n)
+
+    def body(i, keep):
+        # suppressed if any higher-ranked kept box overlaps > threshold
+        sup = jnp.any((iou[i] > iou_threshold) & keep & (jnp.arange(n) < i))
+        return keep.at[i].set(valid_o[i] & ~sup)
+
+    keep = lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+    kept_rank = jnp.where(keep, jnp.arange(n), n)
+    sel = jnp.argsort(kept_rank)[:k]               # first k kept, score order
+    keep_mask = jnp.take(keep, sel)
+    return jnp.take(order, sel), keep_mask

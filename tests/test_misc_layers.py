@@ -1,0 +1,279 @@
+"""Gradient/behavior tests for the misc, step-cell, and detection layers
+(reference pattern: test_LayerGrad.cpp entries for tensor/selective_fc/
+out_prod/multiplex/prelu, test_LayerGrad conv tests, and the SSD layer
+tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import layer as L
+from paddle_tpu import activation as A
+from paddle_tpu import data_type as dt
+from paddle_tpu import networks
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.topology import Topology
+from tests.gradcheck import check_layer_grad
+
+B = 3
+
+
+def dense_feed(name, dim, batch=B, seed=0):
+    rng = np.random.RandomState(seed)
+    return {name: jnp.asarray(rng.randn(batch, dim), jnp.float64)}
+
+
+def data_node(name, dim, seq=False):
+    t = dt.dense_vector_sequence(dim) if seq else dt.dense_vector(dim)
+    return L.data(name=name, type=t)
+
+
+def test_tensor_layer_grad():
+    a, b = data_node("a", 4), data_node("b", 5)
+    out = L.tensor(a, b, size=3, act=A.Tanh())
+    check_layer_grad(out, {**dense_feed("a", 4, seed=1),
+                           **dense_feed("b", 5, seed=2)})
+
+
+def test_selective_fc_grad_and_mask():
+    x = data_node("x", 5)
+    sel = data_node("sel", 4)
+    out = L.selective_fc(input=x, select=sel, size=4, act=A.Sigmoid())
+    rng = np.random.RandomState(0)
+    mask = (rng.rand(B, 4) > 0.5).astype(np.float64)
+    feed = {**dense_feed("x", 5), "sel": jnp.asarray(mask)}
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    vals, _ = topo.apply(params, feed, mode="test")
+    got = np.asarray(vals[out.name])
+    assert np.all(got[mask == 0] == 0.0)
+    # full selection == plain fc with transposed weight
+    out_full = L.selective_fc(input=x, select=None, size=4, act=A.Identity())
+    check_layer_grad(out_full, dense_feed("x", 5))
+
+
+def test_out_prod_grad():
+    a, b = data_node("a", 3), data_node("b", 4)
+    out = L.out_prod(a, b)
+    assert out.size == 12
+    check_layer_grad(out, {**dense_feed("a", 3, seed=1),
+                           **dense_feed("b", 4, seed=2)})
+
+
+def test_multiplex():
+    idx = L.data(name="idx", type=dt.integer_value(3))
+    ins = [data_node("i%d" % k, 4) for k in range(3)]
+    out = L.multiplex(input=[idx] + ins)
+    feeds = {("i%d" % k): jnp.asarray(
+        np.full((B, 4), float(k)), jnp.float32) for k in range(3)}
+    feeds["idx"] = jnp.asarray([2, 0, 1], jnp.int32)
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    vals, _ = topo.apply(params, feeds, mode="test")
+    got = np.asarray(vals[out.name])
+    np.testing.assert_allclose(got[:, 0], [2.0, 0.0, 1.0])
+
+
+def test_prelu_grad():
+    x = data_node("x", 6)
+    out = L.prelu(input=x, partial_sum=2)
+    check_layer_grad(out, dense_feed("x", 6))
+
+
+def test_gated_unit_grad():
+    x = data_node("x", 5)
+    out = L.gated_unit(input=x, size=4, act=A.Tanh())
+    check_layer_grad(out, dense_feed("x", 5))
+
+
+def test_lstm_step_in_group_matches_lstmemory():
+    """lstmemory_unit built from mixed + lstm_step + get_output('state')
+    inside recurrent_group must match the fused lstmemory layer on the
+    same weights (reference: test_RecurrentGradientMachine equivalence
+    pattern)."""
+    from paddle_tpu.graph import reset_name_counters
+
+    dim, hid = 4, 5
+    rng = np.random.RandomState(3)
+    seqs = [rng.randn(l, 4 * hid) for l in (3, 5, 2)]
+    feed = {"xs": SequenceBatch.from_sequences(seqs, max_len=6)}
+
+    reset_name_counters()
+    xs = L.data(name="xs", type=dt.dense_vector_sequence(4 * hid))
+
+    def step(x_t):
+        out_mem = L.memory(name="unit_out", size=hid)
+        state_mem = L.memory(name="unit_state", size=hid)
+        proj = L.mixed(
+            size=4 * hid,
+            input=[L.identity_projection(x_t),
+                   L.full_matrix_projection(out_mem,
+                                            param_attr=paddle.attr.Param(
+                                                name="rec.w"))])
+        lstm = L.lstm_step(input=proj, state=state_mem, size=hid,
+                           name="unit_out", bias_attr=False)
+        L.get_output(lstm, arg_name="state", name="unit_state")
+        return lstm
+
+    grp = L.recurrent_group(step=step, input=[xs], name="grp")
+    topo = Topology(grp)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    vals, _ = topo.apply(params, feed, mode="test")
+    got = vals[grp.name]
+
+    # fused reference path with the same recurrent weight
+    reset_name_counters()
+    xs2 = L.data(name="xs", type=dt.dense_vector_sequence(4 * hid))
+    fused = L.lstmemory(input=xs2, size=hid, bias_attr=False, name="fused")
+    topo2 = Topology(fused)
+    p2 = topo2.init_params(jax.random.PRNGKey(1))
+    p2 = dict(p2)
+    p2["fused.w0"] = params["rec.w"]
+    vals2, _ = topo2.apply(p2, feed, mode="test")
+    want = vals2["fused"]
+    np.testing.assert_allclose(np.asarray(got.data), np.asarray(want.data),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gru_step_in_group_matches_grumemory():
+    from paddle_tpu.graph import reset_name_counters
+
+    hid = 4
+    rng = np.random.RandomState(5)
+    seqs = [rng.randn(l, 3 * hid) for l in (4, 2, 5)]
+    feed = {"xs": SequenceBatch.from_sequences(seqs, max_len=6)}
+
+    reset_name_counters()
+    xs = L.data(name="xs", type=dt.dense_vector_sequence(3 * hid))
+
+    def step(x_t):
+        h_mem = L.memory(name="g_out", size=hid)
+        return L.gru_step(input=x_t, output_mem=h_mem, size=hid,
+                          name="g_out", bias_attr=False,
+                          param_attr=paddle.attr.Param(name="gru.w"))
+
+    grp = L.recurrent_group(step=step, input=[xs])
+    topo = Topology(grp)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    vals, _ = topo.apply(params, feed, mode="test")
+    got = vals[grp.name]
+
+    reset_name_counters()
+    xs2 = L.data(name="xs", type=dt.dense_vector_sequence(3 * hid))
+    fused = L.grumemory(input=xs2, size=hid, bias_attr=False, name="gf")
+    topo2 = Topology(fused)
+    p2 = dict(topo2.init_params(jax.random.PRNGKey(1)))
+    w = np.asarray(params["gru.w"])
+    p2["gf.w0"] = jnp.asarray(w[:, : 2 * hid])   # update/reset block
+    p2["gf.w1"] = jnp.asarray(w[:, 2 * hid:])    # candidate block
+    vals2, _ = topo2.apply(p2, feed, mode="test")
+    np.testing.assert_allclose(np.asarray(got.data),
+                               np.asarray(vals2["gf"].data),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_get_output_aux_only_reachable():
+    """The aux ('state') node must carry the cell's params even when the
+    primary cell output is not part of the graph."""
+    x = data_node("x", 20)
+    c = data_node("c", 5)
+    cell = L.lstm_step(input=x, state=c, size=5)
+    state = L.get_output(cell, arg_name="state", name="cstate")
+    topo = Topology(state)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    feed = {**dense_feed("x", 20, seed=1), **dense_feed("c", 5, seed=2)}
+    vals, _ = topo.apply(params, feed, mode="test")
+    assert np.asarray(vals["cstate"]).shape == (B, 5)
+
+
+def test_conv_projection_in_mixed():
+    img = L.data(name="img", type=dt.dense_vector(2 * 6 * 6), height=6, width=6)
+    out = L.mixed(input=[L.conv_projection(img, filter_size=3, num_filters=4,
+                                           stride=1, padding=1)])
+    rng = np.random.RandomState(0)
+    feed = {"img": jnp.asarray(rng.randn(2, 72), jnp.float64)}
+    check_layer_grad(out, feed, samples_per_tensor=4)
+
+
+def test_priorbox_geometry():
+    feat = L.data(name="feat", type=dt.dense_vector(8 * 2 * 2), height=2, width=2)
+    img = L.data(name="img", type=dt.dense_vector(3 * 8 * 8), height=8, width=8)
+    pb = L.priorbox(input=feat, image=img, min_size=[4], max_size=[8],
+                    aspect_ratio=[2.0], variance=[0.1, 0.1, 0.2, 0.2])
+    # priors per cell = 1 (min) + 1 (sqrt(min*max)) + 2 (ar 2, 1/2) = 4
+    assert pb.num_priors == 2 * 2 * 4
+    topo = Topology(pb)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    feed = {"feat": jnp.zeros((1, 32)), "img": jnp.zeros((1, 192))}
+    vals, _ = topo.apply(params, feed, mode="test")
+    priors = np.asarray(vals[pb.name])
+    assert priors.shape == (16, 8)
+    assert (priors[:, :4] >= 0).all() and (priors[:, :4] <= 1).all()
+    np.testing.assert_allclose(priors[:, 4:], np.tile([0.1, 0.1, 0.2, 0.2],
+                                                      (16, 1)))
+    # first prior of cell (0,0): center (2,2) in 8x8 image, min box 4x4
+    np.testing.assert_allclose(priors[0, :4], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+
+
+def test_cross_channel_norm_grad():
+    img = L.data(name="img", type=dt.dense_vector(3 * 2 * 2), height=2, width=2)
+    out = L.cross_channel_norm(input=img)
+    rng = np.random.RandomState(0)
+    feed = {"img": jnp.asarray(rng.randn(B, 12) + 0.5, jnp.float64)}
+    check_layer_grad(out, feed)
+
+
+def _ssd_setup():
+    feat = L.data(name="feat", type=dt.dense_vector(8 * 2 * 2), height=2, width=2)
+    img = L.data(name="img", type=dt.dense_vector(3 * 8 * 8), height=8, width=8)
+    pb = L.priorbox(input=feat, image=img, min_size=[4], max_size=None,
+                    aspect_ratio=[], variance=[0.1, 0.1, 0.2, 0.2])
+    num_p = pb.num_priors  # 4 cells x 1 prior
+    loc = L.fc(input=feat, size=num_p * 4, act=A.Identity(), name="loc")
+    conf = L.fc(input=feat, size=num_p * 3, act=A.Identity(), name="conf")
+    return feat, img, pb, loc, conf, num_p
+
+
+def test_multibox_loss_grad():
+    feat, img, pb, loc, conf, num_p = _ssd_setup()
+    gt = L.data(name="gt", type=dt.dense_vector_sequence(6))
+    cost = L.multibox_loss(input_loc=loc, input_conf=conf, priorbox=pb,
+                           label=gt, num_classes=3)
+    rng = np.random.RandomState(0)
+    boxes = []
+    for _ in range(2):
+        n = rng.randint(1, 3)
+        rows = []
+        for _ in range(n):
+            x0, y0 = rng.rand(2) * 0.5
+            rows.append([rng.randint(1, 3), x0, y0, x0 + 0.3, y0 + 0.3, 0.0])
+        boxes.append(np.asarray(rows))
+    feed = {
+        "feat": jnp.asarray(rng.randn(2, 32), jnp.float64),
+        "img": jnp.zeros((2, 192), jnp.float64),
+        "gt": SequenceBatch.from_sequences(boxes, max_len=4),
+    }
+    check_layer_grad(cost, feed, check_inputs=False, samples_per_tensor=4)
+
+
+def test_detection_output_shapes_and_sanity():
+    feat, img, pb, loc, conf, num_p = _ssd_setup()
+    det = L.detection_output(input_loc=loc, input_conf=conf, priorbox=pb,
+                             num_classes=3, keep_top_k=5,
+                             confidence_threshold=0.01)
+    topo = Topology(det)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    feed = {"feat": jnp.asarray(rng.randn(2, 32), jnp.float32),
+            "img": jnp.zeros((2, 192), jnp.float32)}
+    vals, _ = topo.apply(params, feed, mode="test")
+    out = np.asarray(vals[det.name])
+    assert out.shape == (2, 5, 7)
+    labels = out[..., 1]
+    valid = labels >= 0
+    assert ((labels[valid] == 1) | (labels[valid] == 2)).all()
+    bx = out[valid][:, 3:]
+    assert (bx >= 0).all() and (bx <= 1).all()
