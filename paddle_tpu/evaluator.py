@@ -184,11 +184,439 @@ def precision_recall(input, label, weight=None, name=None, positive_label=None):
     return _mk_eval("precision_recall", forward, inputs, name, _acc_add, result)
 
 
+def chunk(input, label, chunk_scheme="IOB", num_chunk_types=None,
+          excluded_chunk_types=None, name=None):
+    """Chunk-level precision/recall/F1 for sequence tagging — the NER
+    metric (reference: ChunkEvaluator.cpp:288; chunk_evaluator DSL).
+
+    Tag encoding matches the reference: tag = chunk_type * num_tag_types +
+    tag_type, with O = num_chunk_types * num_tag_types. Schemes: plain,
+    IOB, IOE, IOBES. All chunk extraction is vectorized on device: a
+    predicted chunk is correct iff no begin/end/type disagreement occurs
+    anywhere inside its span (prefix-sum of mismatch flags)."""
+    scheme = chunk_scheme
+    n_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    enforce(num_chunk_types is not None, "chunk: num_chunk_types required")
+    o_tag = num_chunk_types * n_tag
+    excluded = set(excluded_chunk_types or ())
+
+    def split(tags):
+        """-> (chunk_type, tag_type, is_o) with excluded types forced to O."""
+        is_o = tags >= o_tag
+        ctype = jnp.where(is_o, -1, tags // n_tag)
+        ttype = jnp.where(is_o, -1, tags % n_tag)
+        for ex in excluded:
+            is_o = is_o | (ctype == ex)
+        ctype = jnp.where(is_o, -1, ctype)
+        return ctype, ttype, is_o
+
+    def begins_ends(tags, valid):
+        ctype, ttype, is_o = split(tags)
+        prev_c = jnp.pad(ctype[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+        prev_t = jnp.pad(ttype[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+        prev_o = jnp.pad(is_o[:, :-1], ((0, 0), (1, 0)), constant_values=True)
+        next_c = jnp.pad(ctype[:, 1:], ((0, 0), (0, 1)), constant_values=-1)
+        next_t = jnp.pad(ttype[:, 1:], ((0, 0), (0, 1)), constant_values=-1)
+        next_o = jnp.pad(is_o[:, 1:], ((0, 0), (0, 1)), constant_values=True)
+        # positions past each sequence's end look like O
+        prev_o = prev_o | ~jnp.pad(valid[:, :-1], ((0, 0), (1, 0)),
+                                   constant_values=False)
+        next_o = next_o | ~jnp.pad(valid[:, 1:], ((0, 0), (0, 1)),
+                                   constant_values=False)
+        diff_prev = prev_o | (prev_c != ctype)
+        diff_next = next_o | (next_c != ctype)
+        if scheme == "plain":
+            begin = diff_prev
+            end = diff_next
+        elif scheme == "IOB":          # tag_type: B=0, I=1
+            begin = (ttype == 0) | diff_prev
+            end = diff_next | (next_t == 0)
+        elif scheme == "IOE":          # tag_type: I=0, E=1
+            begin = diff_prev | (prev_t == 1)
+            end = (ttype == 1) | diff_next
+        else:                          # IOBES: B=0, I=1, E=2, S=3
+            begin = (ttype == 0) | (ttype == 3) | diff_prev
+            end = (ttype == 2) | (ttype == 3) | diff_next
+        ok = valid & ~is_o
+        return begin & ok, end & ok, ctype, ok
+
+    def count_correct(p_beg, p_end, p_c, l_beg, l_end, l_c, l_in_chunk):
+        mismatch = (p_beg != l_beg) | (p_end != l_end) | \
+            (p_beg & l_beg & (p_c != l_c)) | \
+            ((p_c != l_c) & l_in_chunk)
+        mis_cum = jnp.cumsum(mismatch.astype(jnp.int32), axis=1)
+        t = p_beg.shape[1]
+        pos = jnp.arange(t)[None, :]
+        # last begin position at or before i (in pred)
+        lastb = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(p_beg, pos, -1), axis=1)
+        s_cum = jnp.take_along_axis(
+            mis_cum, jnp.clip(lastb, 0, t - 1), axis=1)
+        s_mis = jnp.take_along_axis(
+            mismatch, jnp.clip(lastb, 0, t - 1), axis=1)
+        span_clean = (mis_cum - s_cum + s_mis) == 0
+        return jnp.sum(p_end & l_end & (lastb >= 0) & span_clean)
+
+    def forward(params, values, ctx):
+        pred, lab = values[0], values[1]
+        enforce(is_seq(pred) and is_seq(lab), "chunk expects sequences")
+        p_tags = data_of(pred)
+        if p_tags.ndim == 3:  # score matrix: take argmax tags
+            p_tags = jnp.argmax(p_tags, axis=-1)
+        p_tags = p_tags.astype(jnp.int32)
+        l_tags = data_of(lab).astype(jnp.int32)
+        valid = lab.mask()
+        p_beg, p_end, p_c, _ = begins_ends(p_tags, valid)
+        l_beg, l_end, l_c, l_in_chunk = begins_ends(l_tags, valid)
+        correct = count_correct(p_beg, p_end, p_c, l_beg, l_end, l_c,
+                                l_in_chunk)
+        return {"num_correct": correct.astype(jnp.float32),
+                "num_pred": jnp.sum(p_beg).astype(jnp.float32),
+                "num_label": jnp.sum(l_beg).astype(jnp.float32)}
+
+    def result(acc):
+        if not acc:
+            return {}
+        prec = acc["num_correct"] / max(acc["num_pred"], 1.0)
+        rec = acc["num_correct"] / max(acc["num_label"], 1.0)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return {"precision": float(prec), "recall": float(rec), "f1": float(f1)}
+
+    return _mk_eval("chunk", forward, [input, label], name, _acc_add, result)
+
+
+def ctc_error(input, label, name=None):
+    """Sequence-normalized CTC edit distance (reference:
+    CTCErrorEvaluator.cpp:277 — best-path decode then Levenshtein vs the
+    label). blank = 0, matching the ctc layer contract."""
+
+    def forward(params, values, ctx):
+        pred, lab = values[0], values[1]
+        enforce(is_seq(pred) and is_seq(lab), "ctc_error expects sequences")
+        scores = data_of(pred)
+        frames = jnp.argmax(scores, axis=-1).astype(jnp.int32)   # [B, T]
+        fmask = pred.mask()
+        # collapse repeats then drop blanks (best-path decode)
+        prev = jnp.pad(frames[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+        keep = (frames != prev) & (frames != 0) & fmask
+        t = frames.shape[1]
+        order = jnp.where(keep, jnp.arange(t)[None, :], t)
+        idx = jnp.argsort(order, axis=1)
+        dec = jnp.take_along_axis(jnp.where(keep, frames, 0), idx, axis=1)
+        dec_len = jnp.sum(keep, axis=1)
+
+        ref = data_of(lab).astype(jnp.int32)
+        ref_len = lab.lengths
+        dist = _edit_distance(dec, dec_len, ref, ref_len)
+        return {"dist": jnp.sum(dist / jnp.maximum(ref_len.astype(jnp.float32), 1.0)),
+                "count": jnp.asarray(dist.shape[0], jnp.float32),
+                "total_dist": jnp.sum(dist),
+                "total_ref": jnp.sum(ref_len).astype(jnp.float32)}
+
+    def result(acc):
+        if not acc or acc["count"] == 0:
+            return 0.0
+        return float(acc["dist"] / acc["count"])
+
+    return _mk_eval("ctc_error", forward, [input, label], name, _acc_add, result)
+
+
+def _edit_distance(a, a_len, b, b_len):
+    """Batched Levenshtein distance over padded id arrays.
+    a [B, Ta], b [B, Tb] -> [B] float32. One lax.scan over a's positions,
+    carrying the DP row — fixed shapes, jit-safe."""
+    ta, tb = a.shape[1], b.shape[1]
+    big = jnp.float32(1e9)
+    jb = jnp.arange(tb + 1, dtype=jnp.float32)
+    row0 = jnp.broadcast_to(jb, (a.shape[0], tb + 1))  # distance from empty a
+
+    def step(row, i):
+        ai = a[:, i]                                      # [B]
+        sub_cost = (ai[:, None] != b).astype(jnp.float32)  # [B, Tb]
+        new_first = row[:, :1] + 1.0
+
+        def inner(carry, j):
+            left = carry                                   # new_row[j] [B]
+            diag = row[:, j]
+            up = row[:, j + 1]
+            val = jnp.minimum(jnp.minimum(left + 1.0, up + 1.0),
+                              diag + sub_cost[:, j])
+            return val, val
+
+        _, cols = jax.lax.scan(inner, new_first[:, 0], jnp.arange(tb))
+        new_row = jnp.concatenate([new_first, cols.T], axis=1)
+        # rows beyond a's length keep the old row
+        alive = (i < a_len)[:, None]
+        new_row = jnp.where(alive, new_row, row)
+        return new_row, None
+
+    row, _ = jax.lax.scan(step, row0, jnp.arange(ta))
+    return jnp.take_along_axis(row, b_len[:, None].astype(jnp.int32),
+                               axis=1)[:, 0]
+
+
+def pnpair(input, label, query_id, weight=None, name=None):
+    """Positive-negative pair statistic for ranking (reference:
+    PnpairEvaluator — within each query, count concordant / discordant /
+    tied score pairs over label-ordered pairs)."""
+    inputs = [input, label, query_id] + ([weight] if weight is not None else [])
+
+    def forward(params, values, ctx):
+        score = data_of(values[0]).reshape(-1)
+        y = data_of(values[1]).reshape(-1).astype(jnp.float32)
+        q = data_of(values[2]).reshape(-1).astype(jnp.int32)
+        w = (data_of(values[3]).reshape(-1)
+             if weight is not None else jnp.ones_like(score))
+        same_q = q[:, None] == q[None, :]
+        label_gt = y[:, None] > y[None, :]
+        pair_w = (w[:, None] + w[None, :]) * 0.5
+        mask = same_q & label_gt
+        sdiff = score[:, None] - score[None, :]
+        pos = jnp.sum(jnp.where(mask & (sdiff > 0), pair_w, 0.0))
+        neg = jnp.sum(jnp.where(mask & (sdiff < 0), pair_w, 0.0))
+        spe = jnp.sum(jnp.where(mask & (sdiff == 0), pair_w, 0.0))
+        return {"pos": pos, "neg": neg, "spe": spe}
+
+    def result(acc):
+        if not acc:
+            return {}
+        pos, neg, spe = acc["pos"], acc["neg"] + 1e-12, acc["spe"]
+        return {"pos/neg": float(pos / neg),
+                "pos": float(pos), "neg": float(acc["neg"]), "spe": float(spe)}
+
+    return _mk_eval("pnpair", forward, inputs, name, _acc_add, result)
+
+
+def detection_map(input, label, overlap_threshold=0.5, background_id=0,
+                  evaluate_difficult=False, ap_type="11point", name=None):
+    """Mean average precision over detection_output rows (reference:
+    DetectionMAPEvaluator.cpp:306). ``input`` is a detection_output layer
+    ([B, K, 7] rows); ``label`` the ground-truth box sequence
+    ([label, xmin, ymin, xmax, ymax, difficult]).
+
+    Per batch the device computes TP/FP flags per detection (greedy match
+    by score against unclaimed gt of the same class); the host accumulates
+    (class, score, tp) triples and the per-class positive counts, and
+    finalizes AP by the 11-point or integral rule."""
+    from paddle_tpu.ops import detection as det_ops
+
+    def forward(params, values, ctx):
+        det, gt = values[0], values[1]
+        rows = data_of(det)                         # [B, K, 7]
+        enforce(is_seq(gt), "detection_map label must be a sequence")
+        gt_rows = data_of(gt)                       # [B, G, 6]
+        gt_valid = gt.mask()
+
+        def per_sample(drows, grows, gvalid):
+            dcls = drows[:, 1].astype(jnp.int32)
+            dscore = drows[:, 2]
+            dbox = drows[:, 3:7]
+            dvalid = dcls >= 0
+            gcls = grows[:, 0].astype(jnp.int32)
+            gbox = grows[:, 1:5]
+            gdiff = grows[:, 5] > 0.5
+            gkeep = gvalid if evaluate_difficult else (gvalid & ~gdiff)
+            iou = det_ops.jaccard_overlap(dbox, gbox)   # [K, G]
+            same_cls = dcls[:, None] == gcls[None, :]
+            cand = iou * jnp.where(same_cls & gkeep[None, :], 1.0, 0.0)
+            # greedy by score order: each gt claimed once
+            order = jnp.argsort(-jnp.where(dvalid, dscore, -jnp.inf))
+
+            def body(claimed, k):
+                i = order[k]
+                ious = jnp.where(claimed, -1.0, cand[i])
+                j = jnp.argmax(ious)
+                hit = (ious[j] > overlap_threshold) & dvalid[i]
+                claimed = claimed.at[j].set(claimed[j] | hit)
+                return claimed, (i, hit)
+
+            _, (idxs, hits) = jax.lax.scan(
+                body, jnp.zeros(gbox.shape[0], bool),
+                jnp.arange(drows.shape[0]))
+            tp = jnp.zeros(drows.shape[0], bool).at[idxs].set(hits)
+            # VOC protocol: a detection whose only match is a difficult gt
+            # is ignored (neither TP nor FP) when evaluate_difficult=False
+            if evaluate_difficult:
+                ignore = jnp.zeros_like(tp)
+            else:
+                diff_cand = (iou > overlap_threshold) & same_cls & \
+                    (gvalid & gdiff)[None, :]
+                ignore = ~tp & jnp.any(diff_cand, axis=1)
+            return tp, ignore
+
+        tp, ignore = jax.vmap(per_sample)(rows, gt_rows, gt_valid)
+        gcls_all = gt_rows[..., 0].astype(jnp.int32)
+        gdiff_all = gt_rows[..., 5] > 0.5
+        gkeep_all = gt_valid if evaluate_difficult else (gt_valid & ~gdiff_all)
+        return {"rows_cls": rows[..., 1], "rows_score": rows[..., 2],
+                "tp": tp, "ignore": ignore,
+                "gt_cls": jnp.where(gkeep_all, gcls_all, -1)}
+
+    def merge(acc, stats):
+        if acc is None:
+            acc = {"cls": [], "score": [], "tp": [], "npos": {}}
+        cls = np.asarray(stats["rows_cls"]).reshape(-1)
+        score = np.asarray(stats["rows_score"]).reshape(-1)
+        tp = np.asarray(stats["tp"]).reshape(-1)
+        ignore = np.asarray(stats["ignore"]).reshape(-1)
+        keep = (cls >= 0) & ~ignore
+        acc["cls"].append(cls[keep])
+        acc["score"].append(score[keep])
+        acc["tp"].append(tp[keep])
+        for c in np.asarray(stats["gt_cls"]).reshape(-1):
+            if c >= 0:
+                acc["npos"][int(c)] = acc["npos"].get(int(c), 0) + 1
+        return acc
+
+    def result(acc):
+        if not acc or not acc["cls"]:
+            return 0.0
+        cls = np.concatenate(acc["cls"])
+        score = np.concatenate(acc["score"])
+        tp = np.concatenate(acc["tp"])
+        aps = []
+        for c, npos in acc["npos"].items():
+            sel = cls == c
+            if npos == 0:
+                continue
+            if not sel.any():
+                aps.append(0.0)
+                continue
+            order = np.argsort(-score[sel])
+            tps = tp[sel][order]
+            tp_cum = np.cumsum(tps)
+            fp_cum = np.cumsum(~tps)
+            rec = tp_cum / npos
+            prec = tp_cum / np.maximum(tp_cum + fp_cum, 1)
+            if ap_type == "11point":
+                ap = np.mean([prec[rec >= t].max() if (rec >= t).any() else 0.0
+                              for t in np.linspace(0, 1, 11)])
+            else:  # integral
+                ap = float(np.sum(np.diff(np.concatenate([[0.0], rec]))
+                                  * prec))
+            aps.append(float(ap))
+        return float(np.mean(aps)) if aps else 0.0
+
+    return _mk_eval("detection_map", forward, [input, label], name, merge,
+                    result)
+
+
 def value_printer(input, name=None):
     """Print layer values each eval (reference: ValuePrinter gadget)."""
     from paddle_tpu.layer.sequence import print_layer
 
     return print_layer(input, name=name)
+
+
+def _printer(kind, inputs, name, extract, render):
+    """Shared shape of the printer evaluators (reference: Evaluator.cpp
+    printer gadgets — side-channel debugging output, result is None)."""
+    from paddle_tpu.utils.logger import logger
+
+    def forward(params, values, ctx):
+        return extract(values)
+
+    def merge(acc, stats):
+        logger.info("%s: %s", kind, render(stats))
+        return acc or {}
+
+    def result(acc):
+        return None
+
+    return _mk_eval(kind, forward, inputs, name, merge, result)
+
+
+def gradient_printer(input, name=None):
+    """Print the mean/absmax of the layer's output values — the reference
+    prints gradients at this point in the pipeline; under jax.grad there is
+    no per-layer gradient buffer, so value stats are the analogue
+    (reference: GradientPrinter)."""
+    return _printer(
+        "gradient_printer", [input], name,
+        lambda values: {"mean": jnp.mean(data_of(values[0])),
+                        "absmax": jnp.max(jnp.abs(data_of(values[0])))},
+        lambda s: "mean=%.6g absmax=%.6g" % (float(s["mean"]), float(s["absmax"])))
+
+
+def maxid_printer(input, num_results=5, name=None):
+    """Print the top-k ids of each sample (reference: MaxIdPrinter)."""
+    def extract(values):
+        x = data_of(values[0])
+        _, idx = jax.lax.top_k(x.reshape(-1, x.shape[-1]),
+                               min(num_results, x.shape[-1]))
+        return {"ids": idx}
+
+    return _printer("maxid_printer", [input], name, extract,
+                    lambda s: np.asarray(s["ids"]).tolist())
+
+
+def maxframe_printer(input, num_frames=5, name=None):
+    """Print the per-sequence frames with maximal value (reference:
+    MaxFramePrinter)."""
+    def extract(values):
+        x = values[0]
+        enforce(is_seq(x), "maxframe_printer expects a sequence")
+        score = jnp.max(data_of(x), axis=-1)
+        score = jnp.where(x.mask(), score, -jnp.inf)
+        _, idx = jax.lax.top_k(score, min(num_frames, score.shape[1]))
+        return {"frames": idx}
+
+    return _printer("maxframe_printer", [input], name, extract,
+                    lambda s: np.asarray(s["frames"]).tolist())
+
+
+def seqtext_printer(input, id_to_word=None, name=None):
+    """Print decoded id sequences, optionally mapped through a vocabulary
+    dict (reference: SeqTextPrinter — result_file/dict_file variant)."""
+    def extract(values):
+        x = values[0]
+        enforce(is_seq(x), "seqtext_printer expects an id sequence")
+        ids = data_of(x)
+        if ids.ndim == 3:
+            ids = jnp.argmax(ids, axis=-1)
+        return {"ids": ids.astype(jnp.int32), "lengths": x.lengths}
+
+    def render(s):
+        ids = np.asarray(s["ids"])
+        lens = np.asarray(s["lengths"])
+        out = []
+        for row, l in zip(ids, lens):
+            toks = row[: int(l)].tolist()
+            if id_to_word:
+                toks = [id_to_word.get(t, "<unk>") for t in toks]
+            out.append(" ".join(str(t) for t in toks))
+        return " | ".join(out)
+
+    return _printer("seqtext_printer", [input], name, extract, render)
+
+
+def classification_error_printer(input, label, name=None):
+    """Print per-sample 0/1 classification errors (reference:
+    ClassificationErrorPrinter)."""
+    def extract(values):
+        x = data_of(values[0])
+        y = data_of(values[1]).reshape(-1).astype(jnp.int32)
+        pred = jnp.argmax(x.reshape(-1, x.shape[-1]), axis=-1).astype(jnp.int32)
+        return {"err": (pred != y).astype(jnp.float32)}
+
+    return _printer("classification_error_printer", [input, label], name,
+                    extract, lambda s: np.asarray(s["err"]).tolist())
+
+
+# reference-DSL alias names (trainer_config_helpers/evaluators.py)
+classification_error_evaluator = classification_error
+auc_evaluator = auc
+pnpair_evaluator = pnpair
+precision_recall_evaluator = precision_recall
+ctc_error_evaluator = ctc_error
+chunk_evaluator = chunk
+detection_map_evaluator = detection_map
+value_printer_evaluator = value_printer
+gradient_printer_evaluator = gradient_printer
+maxid_printer_evaluator = maxid_printer
+maxframe_printer_evaluator = maxframe_printer
+seqtext_printer_evaluator = seqtext_printer
+classification_error_printer_evaluator = classification_error_printer
 
 
 def jax_one_hot(idx, n):

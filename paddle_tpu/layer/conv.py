@@ -42,11 +42,11 @@ def _pair(v):
 
 def conv_geometry(input, num_channels, filter_size, stride, padding,
                   filter_size_y=None, stride_y=None, padding_y=None,
-                  caffe_mode=True):
+                  caffe_mode=True, dilation=(1, 1), trans=False):
     """Shared conv geometry parsing: returns (c, h, w, fh, fw, sh, sw, ph,
-    pw, oh, ow). One place for the *_y-override and out-size rules used by
-    img_conv, conv_projection and conv_operator (cf. config_parser.py
-    conv geometry flow)."""
+    pw, oh, ow). One place for the *_y-override, dilation, transpose and
+    out-size rules used by img_conv, conv_projection and conv_operator
+    (cf. config_parser.py conv geometry flow)."""
     c, h, w = _img_shape(input, num_channels)
     fh = int(filter_size_y if filter_size_y is not None else _pair(filter_size)[0])
     fw = _pair(filter_size)[1]
@@ -54,8 +54,14 @@ def conv_geometry(input, num_channels, filter_size, stride, padding,
     sw = _pair(stride)[1]
     ph = int(padding_y if padding_y is not None else _pair(padding)[0])
     pw = _pair(padding)[1]
-    oh = conv_ops.out_size(h, fh, sh, ph, caffe_mode)
-    ow = conv_ops.out_size(w, fw, sw, pw, caffe_mode)
+    dil = _pair(dilation)
+    if trans:
+        oh, ow = (h - 1) * sh - 2 * ph + fh, (w - 1) * sw - 2 * pw + fw
+    else:
+        oh = conv_ops.out_size(h, fh + (fh - 1) * (dil[0] - 1), sh, ph,
+                               caffe_mode)
+        ow = conv_ops.out_size(w, fw + (fw - 1) * (dil[1] - 1), sw, pw,
+                               caffe_mode)
     return c, h, w, fh, fw, sh, sw, ph, pw, oh, ow
 
 
@@ -92,22 +98,14 @@ def img_conv(input, filter_size, num_filters, name=None, num_channels=None,
     """2-D convolution (reference: ExpandConvLayer = im2col+GEMM,
     CudnnConvLayer; trans=True -> ConvTransLayer). On TPU this is one XLA
     convolution instruction tiled onto the MXU — no im2col materialization."""
-    c, h, w = _img_shape(input, num_channels)
-    fh = int(filter_size_y if filter_size_y is not None else _pair(filter_size)[0])
-    fw = _pair(filter_size)[1]
-    sh = int(stride_y if stride_y is not None else _pair(stride)[0])
-    sw = _pair(stride)[1]
-    ph = int(padding_y if padding_y is not None else _pair(padding)[0])
-    pw = _pair(padding)[1]
+    c, h, w, fh, fw, sh, sw, ph, pw, oh, ow = conv_geometry(
+        input, num_channels, filter_size, stride, padding,
+        filter_size_y, stride_y, padding_y, caffe_mode,
+        dilation=dilation, trans=trans)
     dil = _pair(dilation)
     from paddle_tpu.graph import auto_name
 
     name = name or auto_name("conv_layer")
-    if trans:
-        oh, ow = (h - 1) * sh - 2 * ph + fh, (w - 1) * sw - 2 * pw + fw
-    else:
-        oh = conv_ops.out_size(h, fh + (fh - 1) * (dil[0] - 1), sh, ph, caffe_mode)
-        ow = conv_ops.out_size(w, fw + (fw - 1) * (dil[1] - 1), sw, pw, caffe_mode)
     fan_in = c * fh * fw // groups
     wspec = weight_spec(name, 0, (fh, fw, c // groups, num_filters), param_attr,
                         fan_in=fan_in)

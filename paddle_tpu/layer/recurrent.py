@@ -46,9 +46,14 @@ def lstmemory(input, name=None, size=None, reverse=False, act=None,
         if use_peephole
         else None
     )
+    g_name = to_activation(gate_act or "sigmoid").name
+    s_name = to_activation(state_act or "tanh").name
+    o_name = to_activation(act or "tanh").name
     g_act = to_activation(gate_act or "sigmoid").apply
     s_act = to_activation(state_act or "tanh").apply
     o_act = to_activation(act or "tanh").apply
+    standard_acts = (g_name == "sigmoid" and s_name == "tanh"
+                     and o_name == "tanh")
 
     def forward(params, values, ctx):
         x = values[0]
@@ -67,6 +72,8 @@ def lstmemory(input, name=None, size=None, reverse=False, act=None,
             reverse=reverse,
             use_peephole=use_peephole,
             w_peep=params[pspec.name] if pspec else None,
+            standard_acts=standard_acts,
+            out_act=o_act,
         )
         return SequenceBatch(h_seq, x.lengths)
 

@@ -73,17 +73,18 @@ def match_priors(priors, gt_boxes, gt_valid, overlap_threshold):
     Every gt gets its best prior (bipartite step); remaining priors match
     their best gt if IoU > threshold.
     """
+    num_p = priors.shape[0]
     iou = jaccard_overlap(priors, gt_boxes)           # [P, G]
     iou = jnp.where(gt_valid[None, :], iou, -1.0)
     best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)       # [P]
     best_gt_iou = jnp.max(iou, axis=1)                         # [P]
     match = jnp.where(best_gt_iou > overlap_threshold, best_gt, -1)
-    # bipartite: each valid gt claims its single best prior
+    # bipartite step: each valid gt claims its single best prior; invalid
+    # rows scatter out of bounds and are dropped (never touch prior 0)
     best_prior = jnp.argmax(iou, axis=0).astype(jnp.int32)     # [G]
     gt_ids = jnp.arange(gt_boxes.shape[0], dtype=jnp.int32)
-    claimed = jnp.where(gt_valid, best_prior, -1)
-    match = match.at[jnp.clip(claimed, 0, priors.shape[0] - 1)].set(
-        jnp.where(gt_valid, gt_ids, match[jnp.clip(claimed, 0, priors.shape[0] - 1)]))
+    claimed = jnp.where(gt_valid, best_prior, num_p)
+    match = match.at[claimed].set(gt_ids, mode="drop")
     match_iou = jnp.where(match >= 0,
                           jnp.take_along_axis(
                               iou, jnp.clip(match, 0, iou.shape[1] - 1)[:, None],
@@ -93,27 +94,31 @@ def match_priors(priors, gt_boxes, gt_valid, overlap_threshold):
 
 
 def nms(boxes, scores, valid, iou_threshold, top_k):
-    """Greedy NMS with fixed output size (reference: applyNMSFast).
-    boxes [N, 4], scores [N], valid [N] bool. Returns (indices [top_k],
-    keep_mask [top_k]) — indices into the input, score-ordered.
+    """Greedy NMS with fixed output size (reference: applyNMSFast —
+    which also considers only the top candidates). boxes [N, 4],
+    scores [N], valid [N] bool. Returns (indices [top_k], keep_mask
+    [top_k]) — indices into the input, score-ordered.
+
+    Only the top ``top_k`` candidates by score enter suppression, so the
+    IoU matrix is [top_k, top_k], not [N, N] — with SSD-scale prior counts
+    (P ~ 8732) that is the difference between 0.6MB and 300MB per class.
     """
+    n = boxes.shape[0]
+    k = min(top_k, n)
     neg = jnp.finfo(scores.dtype).min
     s = jnp.where(valid, scores, neg)
-    order = jnp.argsort(-s)
+    order = jnp.argsort(-s)[:k]
     boxes_o = jnp.take(boxes, order, axis=0)
     valid_o = jnp.take(valid, order)
     iou = jaccard_overlap(boxes_o, boxes_o)
 
-    n = boxes.shape[0]
-    k = min(top_k, n)
-
     def body(i, keep):
         # suppressed if any higher-ranked kept box overlaps > threshold
-        sup = jnp.any((iou[i] > iou_threshold) & keep & (jnp.arange(n) < i))
+        sup = jnp.any((iou[i] > iou_threshold) & keep & (jnp.arange(k) < i))
         return keep.at[i].set(valid_o[i] & ~sup)
 
-    keep = lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
-    kept_rank = jnp.where(keep, jnp.arange(n), n)
-    sel = jnp.argsort(kept_rank)[:k]               # first k kept, score order
+    keep = lax.fori_loop(0, k, body, jnp.zeros((k,), bool))
+    kept_rank = jnp.where(keep, jnp.arange(k), k)
+    sel = jnp.argsort(kept_rank)                   # kept first, score order
     keep_mask = jnp.take(keep, sel)
     return jnp.take(order, sel), keep_mask

@@ -1,0 +1,269 @@
+"""Pallas TPU kernels for the recurrent hot path.
+
+Replaces the reference's hand-fused CUDA RNN kernels (paddle/cuda/
+hl_cuda_lstm.cu ~700 LoC: one kernel per LSTM step with gate math fused;
+hl_gpu_gru.cuh) with one Pallas kernel per *whole sequence*: the recurrent
+weight and the h/c state live in VMEM for the entire scan, per-step gate
+pre-activations stream from HBM, and the small [B,H]x[H,4H] recurrent GEMM
+plus all gate elementwise math fuse into a single program — no per-step
+kernel launches or fusion boundaries (the XLA lax.scan path compiles to a
+while-loop with per-iteration boundaries; this kernel removes them).
+
+Training support is a custom VJP whose backward is a second Pallas kernel
+running the reverse scan (gate activations recomputed from the streamed
+pre-activations — one extra GEMM per step instead of materializing 4 gate
+tensors, the standard rematerialization trade).
+
+Used automatically by ops.rnn.lstm_scan for the standard
+sigmoid/tanh/no-peephole configuration; anything exotic falls back to the
+lax.scan path. CPU tests run the same kernels with interpret=True.
+"""
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas import registers TPU lowerings; in stripped CPU test envs
+    # (axon-patched jax without the tpu plugin) it raises — gate on it
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover - environment dependent
+    pl = None
+    pltpu = None
+    _PALLAS_OK = False
+
+_INTERPRET = False  # flipped by tests on CPU
+
+
+def available():
+    import os
+
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return False
+    return _PALLAS_OK
+
+
+def enabled():
+    """Take the fused path only where it can actually lower: the TPU
+    backend, or anywhere under the tests' explicit interpret flag. On other
+    backends (e.g. gpu) pallas imports fine but Mosaic lowering would fail."""
+    return available() and (jax.default_backend() == "tpu" or _INTERPRET)
+
+
+def _interpret():
+    return _INTERPRET or jax.default_backend() == "cpu"
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+# ---------------------------------------------------------------- forward
+
+def _lstm_fwd_kernel(gates_ref, mask_ref, w_ref, h0_ref, c0_ref,
+                     hseq_ref, cseq_ref, hf_ref, cf_ref, h_scr, c_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h_prev = h_scr[:]
+    c_prev = c_scr[:]
+    z = gates_ref[0] + jnp.dot(h_prev, w_ref[:],
+                               preferred_element_type=jnp.float32)
+    hidden = h_prev.shape[-1]
+    zi = z[:, :hidden]
+    zf = z[:, hidden:2 * hidden]
+    zg = z[:, 2 * hidden:3 * hidden]
+    zo = z[:, 3 * hidden:]
+    i = _sigmoid(zi)
+    f = _sigmoid(zf)
+    g = jnp.tanh(zg)
+    o = _sigmoid(zo)
+    c_new = f * c_prev + i * g
+    h_new = o * jnp.tanh(c_new)
+    m = mask_ref[0]
+    h = jnp.where(m > 0, h_new, h_prev)
+    c = jnp.where(m > 0, c_new, c_prev)
+    h_scr[:] = h
+    c_scr[:] = c
+    hseq_ref[0] = h
+    cseq_ref[0] = c
+
+    @pl.when(t == pl.num_programs(0) - 1)
+    def _():
+        hf_ref[:] = h
+        cf_ref[:] = c
+
+
+def _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0):
+    """gates_tm [T, B, 4H] (input proj + bias), mask_tm [T, B] float,
+    w_rec [H, 4H] -> (h_seq_tm [T, B, H], c_seq_tm, h_f, c_f)."""
+    t, b, g4 = gates_tm.shape
+    hidden = g4 // 4
+    dt = gates_tm.dtype
+    return pl.pallas_call(
+        _lstm_fwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, g4), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, g4), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, hidden), dt),
+            jax.ShapeDtypeStruct((t, b, hidden), dt),
+            jax.ShapeDtypeStruct((b, hidden), dt),
+            jax.ShapeDtypeStruct((b, hidden), dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((b, hidden), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(gates_tm, mask_tm[..., None], w_rec, h0, c0)
+
+
+# --------------------------------------------------------------- backward
+
+def _lstm_bwd_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
+                     cseq_ref, dh_seq_ref, dhf_ref, dcf_ref,
+                     dgates_ref, dw_ref, dh0_ref, dc0_ref,
+                     dh_scr, dc_scr):
+    k = pl.program_id(0)          # 0 .. T-1, processing t = T-1-k
+
+    @pl.when(k == 0)
+    def _():
+        dh_scr[:] = dhf_ref[:]
+        dc_scr[:] = dcf_ref[:]
+        dw_ref[:] = jnp.zeros_like(dw_ref[:])
+
+    h_prev = hprev_ref[0]
+    c_prev = cprev_ref[0]
+    z = gates_ref[0] + jnp.dot(h_prev, w_ref[:],
+                               preferred_element_type=jnp.float32)
+    hidden = h_prev.shape[-1]
+    i = _sigmoid(z[:, :hidden])
+    f = _sigmoid(z[:, hidden:2 * hidden])
+    g = jnp.tanh(z[:, 2 * hidden:3 * hidden])
+    o = _sigmoid(z[:, 3 * hidden:])
+    tc = jnp.tanh(cseq_ref[0])     # tanh(c_t); masked steps zeroed below
+
+    m = mask_ref[0]
+    dh_tot = dh_seq_ref[0] + dh_scr[:]
+    dc_tot = dc_scr[:]
+    dh_eff = jnp.where(m > 0, dh_tot, 0.0)
+    do = dh_eff * tc
+    dc_eff = jnp.where(m > 0, dc_tot, 0.0) + dh_eff * o * (1.0 - tc * tc)
+    di = dc_eff * g
+    df = dc_eff * c_prev
+    dg = dc_eff * i
+    dzi = di * i * (1.0 - i)
+    dzf = df * f * (1.0 - f)
+    dzg = dg * (1.0 - g * g)
+    dzo = do * o * (1.0 - o)
+    dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)
+    dgates_ref[0] = dz
+    dw_ref[:] += jnp.dot(h_prev.T, dz, preferred_element_type=jnp.float32)
+    dh_prev = jnp.where(m > 0, 0.0, dh_tot) + jnp.dot(
+        dz, w_ref[:].T, preferred_element_type=jnp.float32)
+    dc_prev = dc_eff * f + jnp.where(m > 0, 0.0, dc_tot)
+    dh_scr[:] = dh_prev
+    dc_scr[:] = dc_prev
+
+    @pl.when(k == pl.num_programs(0) - 1)
+    def _():
+        dh0_ref[:] = dh_prev
+        dc0_ref[:] = dc_prev
+
+
+def _lstm_bwd(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
+              dh_seq_tm, dh_f, dc_f):
+    t, b, g4 = gates_tm.shape
+    hidden = g4 // 4
+    dt = gates_tm.dtype
+    rev = lambda i: (t - 1 - i, 0, 0)  # noqa: E731
+    rev2 = lambda i: (t - 1 - i, 0, 0)  # noqa: E731
+    fixed = lambda i: (0, 0)           # noqa: E731
+    return pl.pallas_call(
+        _lstm_bwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, g4), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, 1), rev2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, g4), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, g4), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, g4), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, g4), dt),
+            jax.ShapeDtypeStruct((hidden, g4), dt),
+            jax.ShapeDtypeStruct((b, hidden), dt),
+            jax.ShapeDtypeStruct((b, hidden), dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((b, hidden), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(gates_tm, mask_tm[..., None], w_rec, hprev_tm, cprev_tm, cseq_tm,
+      dh_seq_tm, dh_f, dc_f)
+
+
+# ------------------------------------------------------------ public VJP
+
+@jax.custom_vjp
+def lstm_fused(gates_tm, mask_tm, w_rec, h0, c0):
+    """Fused masked LSTM scan (standard gates: i,f = sigmoid; g = tanh;
+    h = o * tanh(c)). gates_tm [T, B, 4H] already holds W_in·x + b.
+    Returns (h_seq_tm [T, B, H], h_f, c_f)."""
+    h_seq, _, h_f, c_f = _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0)
+    return h_seq, h_f, c_f
+
+
+def _vjp_fwd(gates_tm, mask_tm, w_rec, h0, c0):
+    h_seq, c_seq, h_f, c_f = _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0)
+    return (h_seq, h_f, c_f), (gates_tm, mask_tm, w_rec, h0, c0, h_seq, c_seq)
+
+
+def _vjp_bwd(res, cotangents):
+    gates_tm, mask_tm, w_rec, h0, c0, h_seq, c_seq = res
+    dh_seq, dh_f, dc_f = cotangents
+    hprev_tm = jnp.concatenate([h0[None], h_seq[:-1]], axis=0)
+    cprev_tm = jnp.concatenate([c0[None], c_seq[:-1]], axis=0)
+    dgates, dw, dh0, dc0 = _lstm_bwd(gates_tm, mask_tm, w_rec, hprev_tm,
+                                     cprev_tm, c_seq, dh_seq, dh_f, dc_f)
+    return dgates, None, dw, dh0, dc0
+
+
+lstm_fused.defvjp(_vjp_fwd, _vjp_bwd)

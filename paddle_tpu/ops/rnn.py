@@ -27,11 +27,11 @@ def _mm(a, b):
 
 
 def lstm_step(carry, gates_t, w_rec, mask_t, gate_act, state_act,
-              use_peephole=False, w_peep=None):
+              use_peephole=False, w_peep=None, out_act=None):
     """One LSTM step. carry=(h, c); gates_t [B, 4H] is the precomputed
     input projection (+bias); w_rec [H, 4H]. Matches the reference's
-    hl_lstm gate math (hl_cuda_lstm.cu): i,f = sigmoid, candidate g and
-    output transform via ``state_act`` (tanh default)."""
+    hl_lstm gate math (hl_cuda_lstm.cu): i,f = sigmoid, candidate g via
+    ``state_act``, cell output via ``out_act`` (both tanh by default)."""
     h_prev, c_prev = carry
     hidden = gates_t.shape[-1] // 4
     z = gates_t + _mm(h_prev, w_rec)
@@ -47,7 +47,7 @@ def lstm_step(carry, gates_t, w_rec, mask_t, gate_act, state_act,
     if use_peephole:
         zo = zo + c * po
     o = gate_act(zo)
-    h = o * state_act(c)
+    h = o * (out_act or state_act)(c)
     m = mask_t[:, None]
     h = jnp.where(m, h, h_prev)
     c = jnp.where(m, c, c_prev)
@@ -89,7 +89,8 @@ def _scan_time_major(step_fn, init_carry, inputs_tm, mask_tm, reverse=False):
 
 def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
               gate_act=jax.nn.sigmoid, state_act=jnp.tanh, reverse=False,
-              use_peephole=False, w_peep=None):
+              use_peephole=False, w_peep=None, standard_acts=None,
+              out_act=None):
     """Full-sequence LSTM. x [B, T, D] -> h_seq [B, T, H], (h_T, c_T).
 
     The [B*T, D]x[D, 4H] projection runs outside the scan (one MXU GEMM);
@@ -97,6 +98,10 @@ def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
     ``reverse=True`` runs right-to-left *within each sequence* — because
     state updates are masked, trailing padding passes through untouched,
     reproducing the reference's length-sorted reverse traversal.
+
+    When ``standard_acts`` (sigmoid gates + tanh states) and no peephole,
+    the whole scan runs as one fused Pallas kernel (ops/pallas_kernels.py —
+    hl_cuda_lstm.cu parity, TPU-shaped); otherwise lax.scan.
     """
     b_, t, d = x_btd.shape
     hidden = w_rec.shape[0]
@@ -118,14 +123,32 @@ def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
         gates = sb.reverse().data
     gates_tm = jnp.swapaxes(gates, 0, 1)
     mask_tm = jnp.swapaxes(mask_bt, 0, 1)
-    step = partial(lstm_step, w_rec=w_rec, gate_act=gate_act,
-                   state_act=state_act, use_peephole=use_peephole, w_peep=w_peep)
 
-    def body(carry, xs):
-        g_t, m_t = xs
-        return step(carry, g_t, mask_t=m_t)
+    if standard_acts is None:
+        standard_acts = (gate_act is jax.nn.sigmoid and state_act is jnp.tanh
+                         and (out_act is None or out_act is jnp.tanh))
+    from paddle_tpu.ops import pallas_kernels as pk
 
-    (h_f, c_f), ys = lax.scan(body, (h0, c0), (gates_tm, mask_tm))
+    # fused-path bounds: w_rec ([H,4H] f32) must fit VMEM alongside the
+    # per-step blocks — H=512 is 4MB of weight; H=1024 (16MB) overflows the
+    # 16MB scoped-vmem budget (measured on v5e). Only the real TPU backend
+    # (or the tests' explicit interpret flag) takes this path — other
+    # backends where pallas merely imports would fail at lowering.
+    if (pk.enabled() and standard_acts and not use_peephole
+            and 64 <= hidden <= 512 and gates_tm.dtype == jnp.float32):
+        h_seq_tm, h_f, c_f = pk.lstm_fused(
+            gates_tm, mask_tm.astype(jnp.float32), w_rec, h0, c0)
+        ys = h_seq_tm
+    else:
+        step = partial(lstm_step, w_rec=w_rec, gate_act=gate_act,
+                       state_act=state_act, use_peephole=use_peephole,
+                       w_peep=w_peep, out_act=out_act)
+
+        def body(carry, xs):
+            g_t, m_t = xs
+            return step(carry, g_t, mask_t=m_t)
+
+        (h_f, c_f), ys = lax.scan(body, (h0, c0), (gates_tm, mask_tm))
     h_seq = jnp.swapaxes(ys, 0, 1)
     if reverse:
         from paddle_tpu.core.sequence import SequenceBatch

@@ -1,0 +1,5 @@
+"""Training-curve plotting (parity: python/paddle/v2/plot)."""
+
+from paddle_tpu.plot.plot import Ploter
+
+__all__ = ["Ploter"]

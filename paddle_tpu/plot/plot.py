@@ -1,0 +1,78 @@
+"""Notebook/terminal training curves (parity: python/paddle/v2/plot/plot.py
+Ploter:32 — append (title, step, value) points from the event handler, then
+plot). Degrades gracefully: without matplotlib or a display it logs the
+latest values instead (the reference gated on DISABLE_PLOT / ipython)."""
+
+import os
+
+from paddle_tpu.utils.logger import logger
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+def plot_disabled():
+    return bool(os.environ.get("DISABLE_PLOT", ""))
+
+
+class Ploter(object):
+    """Usage (identical to the reference):
+
+        ploter = Ploter("train_cost", "test_cost")
+        ploter.append("train_cost", step, cost)
+        ploter.plot()          # draws (or logs, headless)
+    """
+
+    def __init__(self, *titles):
+        self.__args__ = titles
+        self.__plot_data__ = {t: PlotData() for t in titles}
+        self.__disable_plot__ = plot_disabled()
+        self.__plt__ = None
+        if not self.__disable_plot__:
+            try:
+                import matplotlib
+
+                if not os.environ.get("DISPLAY"):
+                    matplotlib.use("Agg")
+                import matplotlib.pyplot as plt
+
+                self.__plt__ = plt
+            except ImportError:
+                self.__plt__ = None
+
+    def append(self, title, step, value):
+        assert title in self.__plot_data__, "no such title: %r" % title
+        self.__plot_data__[title].append(step, float(value))
+
+    def plot(self, path=None):
+        if self.__plt__ is None:
+            for title, data in self.__plot_data__.items():
+                if data.value:
+                    logger.info("plot %s: step=%s value=%.6g", title,
+                                data.step[-1], data.value[-1])
+            return
+        plt = self.__plt__
+        plt.close()
+        for title in self.__args__:
+            data = self.__plot_data__[title]
+            plt.plot(data.step, data.value, label=title)
+        plt.legend()
+        if path is not None:
+            plt.savefig(path)
+        elif os.environ.get("DISPLAY"):
+            plt.show()
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()

@@ -1,0 +1,147 @@
+"""Unit tests for the evaluator registry (reference pattern:
+gserver/tests evaluator coverage + ChunkEvaluator/CTCErrorEvaluator/
+DetectionMAPEvaluator behavior checks on hand-computed cases)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import evaluator as ev
+from paddle_tpu import layer as L
+from paddle_tpu import data_type as dt
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.topology import Topology
+
+
+def run_eval(node, feed, params=None):
+    topo = Topology(node)
+    p = params if params is not None else topo.init_params(jax.random.PRNGKey(0))
+    vals, _ = topo.apply(p, feed, mode="test")
+    stats = vals[node.name]
+    acc = node.merge(None, jax.tree_util.tree_map(np.asarray, stats))
+    return node.result(acc)
+
+
+def test_chunk_evaluator_iob():
+    """2 chunk types, IOB: tags B0=0 I0=1 B1=2 I1=3 O=4.
+    label:  [B0 I0 O B1]      pred: [B0 I0 O O]   -> 1 correct of (2 label, 1 pred)
+    label2: [B1 I1 I1]        pred2: [B1 I1 B1]   -> 0 correct (2 pred chunks)
+    """
+    pred_node = L.data(name="pred", type=dt.integer_value_sequence(5))
+    lab_node = L.data(name="lab", type=dt.integer_value_sequence(5))
+    node = ev.chunk(input=pred_node, label=lab_node, chunk_scheme="IOB",
+                    num_chunk_types=2)
+    lab = SequenceBatch.from_sequences(
+        [np.array([0, 1, 4, 2]), np.array([2, 3, 3])], max_len=5)
+    pred = SequenceBatch.from_sequences(
+        [np.array([0, 1, 4, 4]), np.array([2, 3, 2])], max_len=5)
+    res = run_eval(node, {"pred": pred, "lab": lab})
+    # label chunks: {[0-1]t0, [3]t1} + {[0-2]t1} = 3; pred: {[0-1]t0} + {[0-1]t1, [2]t1} = 3
+    # correct: [0-1]t0 only
+    np.testing.assert_allclose(res["precision"], 1.0 / 3, atol=1e-6)
+    np.testing.assert_allclose(res["recall"], 1.0 / 3, atol=1e-6)
+
+
+def test_chunk_evaluator_perfect():
+    pred_node = L.data(name="pred", type=dt.integer_value_sequence(5))
+    lab_node = L.data(name="lab", type=dt.integer_value_sequence(5))
+    node = ev.chunk(input=pred_node, label=lab_node, chunk_scheme="IOBES",
+                    num_chunk_types=1)
+    # IOBES 1 type: B=0 I=1 E=2 S=3 O=4; seq: [B I E O S]
+    seqs = [np.array([0, 1, 2, 4, 3])]
+    sb = SequenceBatch.from_sequences(seqs, max_len=6)
+    res = run_eval(node, {"pred": sb, "lab": sb})
+    assert res["f1"] == 1.0 and res["precision"] == 1.0
+
+
+def test_edit_distance():
+    a = jnp.asarray([[1, 2, 3, 0], [1, 1, 0, 0]], jnp.int32)
+    al = jnp.asarray([3, 2], jnp.int32)
+    b = jnp.asarray([[1, 3, 0], [2, 2, 2]], jnp.int32)
+    bl = jnp.asarray([2, 3], jnp.int32)
+    d = np.asarray(ev._edit_distance(a, al, b, bl))
+    # [1,2,3] vs [1,3] -> 1 deletion; [1,1] vs [2,2,2] -> 2 sub + 1 ins = 3
+    np.testing.assert_allclose(d, [1.0, 3.0])
+
+
+def test_ctc_error_evaluator():
+    # 4 classes (blank=0); frames argmax: [1 1 0 2] -> decode [1, 2] == label
+    pred_node = L.data(name="p", type=dt.dense_vector_sequence(4))
+    lab_node = L.data(name="l", type=dt.integer_value_sequence(4))
+    node = ev.ctc_error(input=pred_node, label=lab_node)
+    frames = np.zeros((1, 4, 4), np.float32)
+    for t, c in enumerate([1, 1, 0, 2]):
+        frames[0, t, c] = 5.0
+    pred = SequenceBatch(jnp.asarray(frames), jnp.asarray([4], jnp.int32))
+    lab = SequenceBatch.from_sequences([np.array([1, 2])], max_len=3)
+    assert run_eval(node, {"p": pred, "l": lab}) == 0.0
+    lab2 = SequenceBatch.from_sequences([np.array([1, 3])], max_len=3)
+    res = run_eval(node, {"p": pred, "l": lab2})
+    np.testing.assert_allclose(res, 0.5)  # 1 sub / len 2
+
+
+def test_pnpair_evaluator():
+    s = L.data(name="s", type=dt.dense_vector(1))
+    y = L.data(name="y", type=dt.integer_value(3))
+    q = L.data(name="q", type=dt.integer_value(10))
+    node = ev.pnpair(input=s, label=y, query_id=q)
+    feed = {
+        "s": jnp.asarray([[0.9], [0.1], [0.5], [0.7]], jnp.float32),
+        "y": jnp.asarray([2, 0, 1, 2], jnp.int32),
+        "q": jnp.asarray([0, 0, 0, 1], jnp.int32),
+    }
+    res = run_eval(node, feed)
+    # query 0 ordered pairs (label_i > label_j): (0,1) s .9>.1 pos;
+    # (0,2) .9>.5 pos; (2,1) .5>.1 pos -> 3 pos, 0 neg
+    assert res["pos"] == 3.0 and res["neg"] == 0.0
+
+
+def test_detection_map_evaluator():
+    det = L.data(name="det", type=dt.dense_vector(2 * 7))
+    gt = L.data(name="gt", type=dt.dense_vector_sequence(6))
+    # one image, two detections of class 1: one perfect box, one off
+    rows = np.array([[[0, 1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                      [0, 1, 0.8, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+    gt_rows = [np.array([[1, 0.1, 0.1, 0.4, 0.4, 0.0]])]
+    feed = {"det": jnp.asarray(rows.reshape(1, 14)),
+            "gt": SequenceBatch.from_sequences(gt_rows, max_len=2)}
+
+    # detection_map expects [B, K, 7]; wrap through a reshaping node
+    def fwd(params, values, ctx):
+        from paddle_tpu.layer.base import data_of
+
+        return data_of(values[0]).reshape(-1, 2, 7)
+
+    from paddle_tpu.layer.base import make_node
+
+    shaped = make_node("reshape_det", fwd, [det], name="shaped", size=14)
+    node = ev.detection_map(input=shaped, label=gt, overlap_threshold=0.5)
+    res = run_eval(node, feed)
+    # one gt, top-scored detection hits -> AP = 1.0 (second det is FP at
+    # lower score, doesn't reduce 11-point AP since recall 1 reached first)
+    np.testing.assert_allclose(res, 1.0, atol=1e-6)
+
+
+def test_printers_run(caplog):
+    x = L.data(name="x", type=dt.dense_vector(4))
+    y = L.data(name="y", type=dt.integer_value(4))
+    feed = {"x": jnp.asarray(np.random.RandomState(0).randn(2, 4), jnp.float32),
+            "y": jnp.asarray([1, 2], jnp.int32)}
+    for node in (ev.gradient_printer(input=x),
+                 ev.maxid_printer(input=x, num_results=2),
+                 ev.classification_error_printer(input=x, label=y)):
+        assert run_eval(node, feed) is None
+    xs = L.data(name="xs", type=dt.integer_value_sequence(9))
+    sb = SequenceBatch.from_sequences([np.array([1, 2, 3])], max_len=4)
+    assert run_eval(ev.seqtext_printer(input=xs,
+                                       id_to_word={1: "a", 2: "b", 3: "c"}),
+                    {"xs": sb}) is None
+
+
+def test_evaluator_aliases():
+    assert ev.chunk_evaluator is ev.chunk
+    assert ev.ctc_error_evaluator is ev.ctc_error
+    assert ev.detection_map_evaluator is ev.detection_map
+    assert ev.pnpair_evaluator is ev.pnpair

@@ -109,3 +109,27 @@ def test_cache_abandoned_first_pass_no_duplicates():
     next(it); next(it)  # abandon mid-pass
     assert list(cached()) == list(range(5))
     assert list(cached()) == list(range(5))
+
+
+def test_flowers_voc2012_schemas():
+    from paddle_tpu.dataset import flowers, voc2012
+
+    img, label = next(flowers.train(synthetic_size=4)())
+    assert img.shape == (3 * 32 * 32,) and 0 <= label < 102
+    img2, seg = next(voc2012.train(synthetic_size=4)())
+    assert img2.shape == (3 * 32 * 32,) and seg.shape == (32 * 32,)
+    assert seg.min() >= 0 and seg.max() < 21
+
+
+def test_ploter_headless(tmp_path):
+    import os
+
+    from paddle_tpu.plot import Ploter
+
+    p = Ploter("train_cost", "test_cost")
+    for i in range(5):
+        p.append("train_cost", i, 1.0 / (i + 1))
+    p.append("test_cost", 0, 0.5)
+    p.plot(path=str(tmp_path / "curve.png"))  # Agg backend or log fallback
+    p.reset()
+    p.plot()
