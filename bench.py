@@ -54,16 +54,25 @@ def main():
 
     # warmup / compile
     loss, params, opt_state = jitted(params, opt_state, data, lengths, labels)
-    jax.block_until_ready(loss)
+    float(loss)  # device->host fetch: the only reliable sync on the tunnel
 
-    iters = 30
-    start = time.perf_counter()
-    for _ in range(iters):
-        loss, params, opt_state = jitted(params, opt_state, data, lengths,
-                                         labels)
-    jax.block_until_ready(loss)
-    elapsed = time.perf_counter() - start
-    ms_per_batch = elapsed / iters * 1000.0
+    def timed_chain(iters, params, opt_state):
+        """Run `iters` chained steps ending in a host fetch. On the axon
+        tunnel backend block_until_ready does not truly synchronize, so we
+        time to a scalar fetch; the fixed round-trip cost cancels in the
+        two-point slope below."""
+        start = time.perf_counter()
+        loss = None
+        for _ in range(iters):
+            loss, params, opt_state = jitted(params, opt_state, data,
+                                             lengths, labels)
+        float(loss)
+        return time.perf_counter() - start, params, opt_state
+
+    n1, n2 = 10, 110
+    t1, params, opt_state = timed_chain(n1, params, opt_state)
+    t2, params, opt_state = timed_chain(n2, params, opt_state)
+    ms_per_batch = max(t2 - t1, 1e-9) / (n2 - n1) * 1000.0
 
     print(json.dumps({
         "metric": "lstm_text_cls_train_ms_per_batch_bs64_h256_seq100",

@@ -54,6 +54,30 @@ def spawn_coordinator(port, snapshot_path="", task_timeout=600.0,
     raise RuntimeError("coordinator did not start within 60s")
 
 
+def spawn_coordinator_on_free_port(snapshot_path="", task_timeout=600.0,
+                                   failure_max=3, retries=5):
+    """Pick a free localhost port and spawn a coordinator on it, retrying on
+    the (inherently racy) probe-then-bind window. Returns (port, Popen)."""
+    last_err = None
+    for _ in range(retries):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        try:
+            return port, spawn_coordinator(
+                port, snapshot_path=snapshot_path, task_timeout=task_timeout,
+                failure_max=failure_max)
+        except RuntimeError as e:
+            # only the probe-then-bind race (process exits at startup) is
+            # worth retrying; a wedged binary (60s timeout) or deterministic
+            # crash should surface immediately rather than cost 5 respawns
+            if "failed to start" not in str(e):
+                raise
+            last_err = e
+    raise last_err
+
+
 class CoordinatorClient:
     def __init__(self, endpoint, worker_id=None, timeout=10.0):
         host, port = endpoint.rsplit(":", 1)

@@ -1,0 +1,162 @@
+"""Long-context parallel transformer: the framework's scale showcase.
+
+Composes every parallelism axis the framework offers in ONE jitted train
+step — the capability superset of the reference's distribution stack
+(SURVEY.md §2.4: MultiGradientMachine dp, ParallelNeuralNetwork model
+placement, sparse/embedding distribution) re-expressed TPU-first:
+
+- dp  : batch sharded over the 'data' mesh axis (grad psum by XLA)
+- ep  : embedding table vocab-sharded over the 'model' axis
+- sp  : ring (or Ulysses) attention, sequence sharded over the 'model'
+        axis — Megatron-SP style, sp rides the tp axis
+- tp  : Megatron column→row dense pair over the 'model' axis
+- pp  : GPipe microbatch pipeline of residual MLP blocks over 'pipe'
+
+The model itself: token embedding → multi-head self-attention (causal)
+→ N pipelined residual MLP blocks → mean-pool → tp-sharded classifier
+head. Tiny-shape friendly; used by __graft_entry__.dryrun_multichip.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel.context_parallel import ring_attention, ulysses_attention
+from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from paddle_tpu.parallel.tensor_parallel import megatron_dense_pair
+from paddle_tpu.utils.error import enforce
+
+
+class ParallelTransformer:
+    """See module docstring. Axis names are configurable; pass the sizes
+    you built the mesh with. ``n_micro`` microbatches stream the pipeline.
+    """
+
+    def __init__(self, mesh, vocab=128, emb=16, heads=4, classes=4,
+                 n_micro=2, data_axis="data", model_axis="model",
+                 pipe_axis="pipe", attention="ring"):
+        enforce(emb % heads == 0, "emb %d must divide heads %d", emb, heads)
+        enforce(attention in ("ring", "ulysses"),
+                "unknown attention strategy %r", attention)
+        self.mesh = mesh
+        self.vocab, self.emb, self.heads, self.classes = vocab, emb, heads, classes
+        self.head_dim = emb // heads
+        self.n_micro = n_micro
+        self.data_axis, self.model_axis, self.pipe_axis = (
+            data_axis, model_axis, pipe_axis)
+        self.n_pipe = mesh.shape[pipe_axis]
+        self.attention = attention
+
+    # parameters -------------------------------------------------------------
+    def init_params(self, rng):
+        n_pipe = self.n_pipe
+        keys = jax.random.split(rng, 6 + n_pipe)
+        e, h, hd = self.emb, self.heads, self.head_dim
+
+        def dense(key, shape, scale=None):
+            scale = scale or (1.0 / np.sqrt(shape[0]))
+            return jax.random.normal(key, shape, jnp.float32) * scale
+
+        params = {
+            "embed": dense(keys[0], (self.vocab, e), 1.0),
+            "qkv_w": dense(keys[1], (e, 3 * e)),
+            "proj_w": dense(keys[2], (e, e)),
+            "head_w1": dense(keys[3], (e, 2 * e)),
+            "head_b1": jnp.zeros((2 * e,), jnp.float32),
+            "head_w2": dense(keys[4], (2 * e, self.classes)),
+            "head_b2": jnp.zeros((self.classes,), jnp.float32),
+            "pipe": stack_stage_params([
+                {"w": dense(keys[6 + i], (e, e)),
+                 "b": jnp.zeros((e,), jnp.float32)}
+                for i in range(n_pipe)
+            ]),
+        }
+        return params
+
+    def param_shardings(self, params):
+        mesh, ma, pa = self.mesh, self.model_axis, self.pipe_axis
+
+        def s(*spec):
+            return NamedSharding(mesh, P(*spec))
+
+        sh = {
+            "embed": s(ma, None),            # ep: vocab-sharded table
+            "qkv_w": s(None, ma),            # tp: column-parallel qkv
+            "proj_w": s(ma, None),           # tp: row-parallel out proj
+            "head_w1": s(None, ma),          # tp pair (column)
+            "head_b1": s(ma),
+            "head_w2": s(ma, None),          # tp pair (row)
+            "head_b2": s(),
+            "pipe": jax.tree_util.tree_map(
+                lambda l: s(*((pa,) + (None,) * (l.ndim - 1))),
+                params["pipe"]),
+        }
+        return sh
+
+    def place(self, params):
+        sh = self.param_shardings(params)
+        return jax.tree_util.tree_map(
+            lambda v, spec: jax.device_put(v, spec), params, sh,
+            is_leaf=lambda x: hasattr(x, "shape"))
+
+    # forward ----------------------------------------------------------------
+    def apply(self, params, tokens):
+        """tokens [B, L] int32 -> logits [B, classes]."""
+        b, l = tokens.shape
+        e, h, hd = self.emb, self.heads, self.head_dim
+        x = jnp.take(params["embed"], tokens, axis=0)          # ep gather
+        # sequence-sharded causal self-attention (sp over the model axis)
+        qkv = jnp.einsum("ble,ef->blf", x, params["qkv_w"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(b, l, h, hd)
+        attn_fn = ring_attention if self.attention == "ring" else ulysses_attention
+        attn = attn_fn(to_heads(q), to_heads(k), to_heads(v), self.mesh,
+                       seq_axis=self.model_axis, causal=True,
+                       batch_axis=self.data_axis)
+        attn = attn.reshape(b, l, e)
+        x = x + jnp.einsum("ble,ef->blf", attn, params["proj_w"])
+        # pipelined residual MLP stack (pp)
+        enforce(b % self.n_micro == 0,
+                "batch %d must divide microbatches %d", b, self.n_micro)
+        mb = b // self.n_micro
+        xs = x.reshape(self.n_micro, mb, l, e)
+
+        def stage(p, t):
+            return t + jnp.tanh(jnp.einsum("mle,ef->mlf", t, p["w"]) + p["b"])
+
+        xs = pipeline_apply(stage, params["pipe"], xs, self.mesh,
+                            axis=self.pipe_axis, batch_axis=self.data_axis)
+        x = xs.reshape(b, l, e)
+        # mean-pool + tp-sharded classifier head
+        pooled = jnp.mean(x, axis=1)
+        return megatron_dense_pair(
+            pooled, params["head_w1"], params["head_b1"],
+            params["head_w2"], params["head_b2"], self.mesh,
+            axis=self.model_axis, batch_axis=self.data_axis)
+
+    def loss(self, params, tokens, labels):
+        logits = self.apply(params, tokens)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
+
+    # reference (unsharded) path for equivalence tests -----------------------
+    def apply_reference(self, params, tokens):
+        from paddle_tpu.parallel.context_parallel import full_attention
+
+        b, l = tokens.shape
+        e, h, hd = self.emb, self.heads, self.head_dim
+        x = jnp.take(params["embed"], tokens, axis=0)
+        qkv = jnp.einsum("ble,ef->blf", x, params["qkv_w"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        rs = lambda t: t.reshape(b, l, h, hd)
+        attn = full_attention(rs(q), rs(k), rs(v), causal=True).reshape(b, l, e)
+        x = x + jnp.einsum("ble,ef->blf", attn, params["proj_w"])
+        for i in range(self.n_pipe):
+            w = params["pipe"]["w"][i]
+            bb = params["pipe"]["b"][i]
+            x = x + jnp.tanh(jnp.einsum("ble,ef->blf", x, w) + bb)
+        pooled = jnp.mean(x, axis=1)
+        hmid = jnp.tanh(pooled @ params["head_w1"] + params["head_b1"])
+        return hmid @ params["head_w2"] + params["head_b2"]

@@ -14,3 +14,18 @@ from paddle_tpu.parallel.mesh import (
     local_device_count,
 )
 from paddle_tpu.parallel import sharded_embedding
+from paddle_tpu.parallel.context_parallel import (
+    SequenceParallel,
+    full_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from paddle_tpu.parallel.tensor_parallel import (
+    TensorParallel,
+    megatron_dense_pair,
+)
+from paddle_tpu.parallel.pipeline import (
+    pipe_sharding,
+    pipeline_apply,
+    stack_stage_params,
+)

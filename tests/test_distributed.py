@@ -20,25 +20,17 @@ from paddle_tpu.distributed.client import (
     COORDINATOR_BIN,
     CoordinatorClient,
     spawn_coordinator,
+    spawn_coordinator_on_free_port,
 )
 from paddle_tpu.distributed import checkpoint as ckpt
 from paddle_tpu.parameters import Parameters
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 @pytest.fixture
 def coordinator(tmp_path):
-    port = _free_port()
     snap = str(tmp_path / "snapshot.json")
-    proc = spawn_coordinator(port, snapshot_path=snap, task_timeout=1.0,
-                             failure_max=2)
+    port, proc = spawn_coordinator_on_free_port(
+        snapshot_path=snap, task_timeout=1.0, failure_max=2)
     yield "127.0.0.1:%d" % port, snap, proc
     proc.kill()
     proc.wait()
@@ -133,8 +125,7 @@ def test_snapshot_recovery(coordinator, tmp_path):
     proc.kill()
     proc.wait()
 
-    port2 = _free_port()
-    proc2 = spawn_coordinator(port2, snapshot_path=snap)
+    port2, proc2 = spawn_coordinator_on_free_port(snapshot_path=snap)
     try:
         c2 = CoordinatorClient("127.0.0.1:%d" % port2, worker_id="w0")
         status = c2.status()

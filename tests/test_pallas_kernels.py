@@ -18,6 +18,14 @@ pytestmark = pytest.mark.skipif(
            "not registered); the fused path is exercised on the real chip "
            "by bench.py and the driver's compile check")
 
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    """Force the fused pallas path in interpret mode on CPU — without this
+    enabled() falls back to lax.scan off-TPU and the fused-vs-scan
+    comparisons would compare the scan path against itself."""
+    monkeypatch.setattr(pk, "_INTERPRET", True)
+
+
 B, T, H = 4, 6, 64
 
 
