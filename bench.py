@@ -6,73 +6,25 @@ IMDB, seq len 100 padded, dict 30k, batch 64, hidden 256 — PaddlePaddle
 --job=time`, benchmark/paddle/rnn/run.sh). Measures steady-state wall time
 of the fused train step (forward + backward + optimizer) on the real chip
 and prints ONE JSON line; vs_baseline > 1 means faster than the reference.
+
+The full published-table suite lives in benchmark/run.py; both share
+benchmark/harness.py (step construction + slope timing).
 """
 
 import json
+import os
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_MS = 83.0  # benchmark/README.md:119 — LSTM bs=64 h=256, K40m
-BATCH, SEQLEN, HIDDEN, DICT, EMB, CLASSES = 64, 100, 256, 30000, 128, 2
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
+    from benchmark.harness import build_rnn_step, chain_slope_ms
 
-    import paddle_tpu as paddle
-    from paddle_tpu.core.sequence import SequenceBatch
-    from paddle_tpu.topology import Topology
-    from paddle_tpu import optimizer as opt
-    import __graft_entry__ as graft
-
-    words, label, out, cost = graft._flagship(
-        dict_size=DICT, emb=EMB, hidden=HIDDEN, classes=CLASSES)
-    topo = Topology(cost)
-    params = topo.init_params(jax.random.PRNGKey(0))
-    optimizer = opt.Momentum(learning_rate=0.01, momentum=0.9)
-    opt_state = optimizer.init_state(params)
-
-    def train_step(params, opt_state, data, lengths, labels):
-        def loss_fn(p):
-            feed = {"word": SequenceBatch(data, lengths), "label": labels}
-            values, _ = topo.apply(p, feed, mode="test")
-            return jnp.mean(values[cost.name])
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        new_params, new_state = optimizer.step(params, grads, opt_state)
-        return loss, new_params, new_state
-
-    jitted = jax.jit(train_step, donate_argnums=(0, 1))
-
-    rng = np.random.RandomState(0)
-    data = jnp.asarray(rng.randint(0, DICT, (BATCH, SEQLEN)), jnp.int32)
-    lengths = jnp.full((BATCH,), SEQLEN, jnp.int32)  # reference pads to 100
-    labels = jnp.asarray(rng.randint(0, CLASSES, (BATCH,)), jnp.int32)
-
-    # warmup / compile
-    loss, params, opt_state = jitted(params, opt_state, data, lengths, labels)
-    float(loss)  # device->host fetch: the only reliable sync on the tunnel
-
-    def timed_chain(iters, params, opt_state):
-        """Run `iters` chained steps ending in a host fetch. On the axon
-        tunnel backend block_until_ready does not truly synchronize, so we
-        time to a scalar fetch; the fixed round-trip cost cancels in the
-        two-point slope below."""
-        start = time.perf_counter()
-        loss = None
-        for _ in range(iters):
-            loss, params, opt_state = jitted(params, opt_state, data,
-                                             lengths, labels)
-        float(loss)
-        return time.perf_counter() - start, params, opt_state
-
-    n1, n2 = 10, 110
-    t1, params, opt_state = timed_chain(n1, params, opt_state)
-    t2, params, opt_state = timed_chain(n2, params, opt_state)
-    ms_per_batch = max(t2 - t1, 1e-9) / (n2 - n1) * 1000.0
+    step, carry, fetch = build_rnn_step(batch=64, hidden=256)
+    ms_per_batch, _ = chain_slope_ms(step, carry, fetch, n1=10, n2=110)
 
     print(json.dumps({
         "metric": "lstm_text_cls_train_ms_per_batch_bs64_h256_seq100",

@@ -1,0 +1,121 @@
+"""Shared benchmark harness (reference driver parity: `paddle train
+--job=time`, benchmark/paddle/image/run.sh + rnn/run.sh).
+
+One place builds the jitted train step for each benchmark config and one
+place times it, so `bench.py` (the driver's flagship metric) and
+`benchmark/run.py` (the full published-table suite) cannot diverge.
+
+Timing: on the axon TPU tunnel `block_until_ready` does not truly
+synchronize, so each timed chain ends in a scalar host fetch (the only
+reliable sync) and the per-batch time is the two-point slope
+(t(n2) - t(n1)) / (n2 - n1) — the fixed fetch round-trip cancels.
+"""
+
+import time
+
+import numpy as np
+
+
+def chain_slope_ms(step, carry, fetch, n1=10, n2=110):
+    """step: carry -> carry (jitted; each call data-depends on the last);
+    fetch: carry -> python scalar (host sync). Returns (ms_per_step, carry)."""
+
+    def timed(iters, carry):
+        start = time.perf_counter()
+        for _ in range(iters):
+            carry = step(carry)
+        fetch(carry)
+        return time.perf_counter() - start, carry
+
+    carry = step(carry)  # warmup / compile
+    fetch(carry)
+    t1, carry = timed(n1, carry)
+    t2, carry = timed(n2, carry)
+    return max(t2 - t1, 1e-9) / (n2 - n1) * 1000.0, carry
+
+
+def _train_step_harness(topo, cost_name, optimizer, feed_of, data):
+    """Carry = (loss, params, opt_state): the loss rides in the carry so
+    fetch() is a scalar device->host read and chained steps data-depend on
+    each other through the donated params."""
+    import jax
+    import jax.numpy as jnp
+
+    def train_step(params, opt_state, *data):
+        def loss_fn(p):
+            values, _ = topo.apply(p, feed_of(*data), mode="test")
+            return jnp.mean(values[cost_name])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_state = optimizer.step(params, grads, opt_state)
+        return loss, new_params, new_state
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    params = topo.init_params(jax.random.PRNGKey(0))
+    opt_state = optimizer.init_state(params)
+    carry = (jnp.zeros(()), params, opt_state)
+    return (lambda c: jitted(c[1], c[2], *data)), carry, \
+        (lambda c: float(c[0]))
+
+
+def build_rnn_step(batch, hidden, seqlen=100, dict_size=30000, emb=128,
+                   classes=2, lr=0.01):
+    """Flagship RNN benchmark: 2x LSTM + fc text classifier, padded
+    sequences (BASELINE.md RNN table)."""
+    import jax.numpy as jnp
+
+    import __graft_entry__ as graft
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.topology import Topology
+
+    words, label, out, cost = graft._flagship(
+        dict_size=dict_size, emb=emb, hidden=hidden, classes=classes)
+    topo = Topology(cost)
+    optimizer = opt.Momentum(learning_rate=lr, momentum=0.9)
+
+    def feed_of(data, lengths, labels):
+        return {"word": SequenceBatch(data, lengths), "label": labels}
+
+    rng = np.random.RandomState(0)
+    data = (
+        jnp.asarray(rng.randint(0, dict_size, (batch, seqlen)), jnp.int32),
+        jnp.full((batch,), seqlen, jnp.int32),  # reference pads to seqlen
+        jnp.asarray(rng.randint(0, classes, (batch,)), jnp.int32),
+    )
+    return _train_step_harness(topo, cost.name, optimizer, feed_of, data)
+
+
+IMAGE_MODELS = {
+    "alexnet": ("alexnet", {}, 3 * 227 * 227, 1000),
+    "googlenet": ("googlenet", {}, 3 * 224 * 224, 1000),
+    "smallnet": ("smallnet_cifar", {}, 3 * 32 * 32, 10),
+    "resnet50": ("resnet", {"depth": 50}, 3 * 224 * 224, 1000),
+}
+
+
+def build_image_step(model_name, batch, lr=0.01):
+    """CNN benchmarks (BASELINE.md CNN table)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import data_type as dt
+    from paddle_tpu import layer as L, optimizer as opt
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models import vision
+    from paddle_tpu.topology import Topology
+
+    reset_name_counters()
+    fn_name, kwargs, in_dim, classes = IMAGE_MODELS[model_name]
+    out = getattr(vision, fn_name)(num_classes=classes, **kwargs)
+    label = L.data(name="label", type=dt.integer_value(classes))
+    cost = L.classification_cost(input=out, label=label)
+    topo = Topology(cost)
+    optimizer = opt.Momentum(learning_rate=lr, momentum=0.9)
+
+    def feed_of(images, labels):
+        return {"image": images, "label": labels}
+
+    rng = np.random.RandomState(0)
+    data = (jnp.asarray(rng.randn(batch, in_dim), jnp.float32),
+            jnp.asarray(rng.randint(0, classes, batch), jnp.int32))
+    return _train_step_harness(topo, cost.name, optimizer, feed_of, data)

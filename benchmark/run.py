@@ -1,0 +1,93 @@
+"""Benchmark suite reproducing the reference's published tables
+(BASELINE.md; reference: benchmark/paddle/image/run.sh + rnn/run.sh driving
+`paddle train --job=time`).
+
+Times the full jitted train step (forward + backward + optimizer, params
+donated) in steady state on whatever backend jax selects (the real TPU chip
+under the default env), using the shared slope-timing harness
+(benchmark/harness.py). Prints one JSON line per configuration —
+``vs_baseline`` > 1 means this framework beats the reference's K40m
+number — plus a closing summary table.
+
+Usage:
+  python benchmark/run.py --suite rnn                 # LSTM table
+  python benchmark/run.py --suite image               # CNN table
+  python benchmark/run.py --suite all --n2 60
+  python benchmark/run.py --suite image --configs smallnet_bs64,alexnet_bs128
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from benchmark.harness import (build_image_step, build_rnn_step,
+                               chain_slope_ms)
+
+# BASELINE.md ms/batch (reference K40m numbers)
+IMAGE_BASELINES = {
+    ("alexnet", 64): 195, ("alexnet", 128): 334, ("alexnet", 256): 602,
+    ("alexnet", 512): 1629,
+    ("googlenet", 64): 613, ("googlenet", 128): 1149, ("googlenet", 256): 2348,
+    ("smallnet", 64): 10.463, ("smallnet", 128): 18.184,
+    ("smallnet", 256): 33.113, ("smallnet", 512): 63.039,
+    ("resnet50", 64): None,  # not in the 2017 table; north-star model
+}
+RNN_BASELINES = {
+    (64, 256): 83, (64, 512): 184, (64, 1280): 641,
+    (128, 256): 110, (128, 512): 261, (128, 1280): 1007,
+    (256, 256): 170, (256, 512): 414, (256, 1280): 1655,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--suite", choices=("image", "rnn", "all"), default="rnn")
+    ap.add_argument("--n1", type=int, default=10,
+                    help="short-chain length for the two-point slope")
+    ap.add_argument("--n2", type=int, default=110,
+                    help="long-chain length for the two-point slope")
+    ap.add_argument("--configs", default="",
+                    help="comma list like smallnet_bs64,alexnet_bs128 or "
+                         "rnn_bs64_h256 to restrict")
+    args = ap.parse_args(argv)
+    only = set(filter(None, args.configs.split(",")))
+
+    rows = []
+
+    def record(name, ms, baseline):
+        vs = round(baseline / ms, 3) if baseline else None
+        line = {"metric": name + "_train_ms_per_batch", "value": round(ms, 3),
+                "unit": "ms/batch", "vs_baseline": vs}
+        print(json.dumps(line), flush=True)
+        rows.append((name, ms, baseline, vs))
+
+    if args.suite in ("rnn", "all"):
+        for (batch, hidden), base in RNN_BASELINES.items():
+            name = "rnn_bs%d_h%d" % (batch, hidden)
+            if only and name not in only:
+                continue
+            step, carry, fetch = build_rnn_step(batch, hidden)
+            ms, _ = chain_slope_ms(step, carry, fetch, args.n1, args.n2)
+            record(name, ms, base)
+    if args.suite in ("image", "all"):
+        for (model, batch), base in IMAGE_BASELINES.items():
+            name = "%s_bs%d" % (model, batch)
+            if only and name not in only:
+                continue
+            step, carry, fetch = build_image_step(model, batch)
+            ms, _ = chain_slope_ms(step, carry, fetch, args.n1, args.n2)
+            record(name, ms, base)
+
+    print("\n%-22s %12s %12s %10s"
+          % ("config", "ms/batch", "baseline", "speedup"))
+    for name, ms, base, vs in rows:
+        print("%-22s %12.3f %12s %10s"
+              % (name, ms, base if base else "-", vs if vs else "-"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""GAN demo (reference: v1_api_demo/gan gan_conf.py + gan_trainer.py).
+
+Trains a generator/discriminator pair with alternating updates. The
+reference used three GradientMachines over shared parameter names; here
+both subnetworks live in one parameter dict and each optimizer step
+filters gradients by name prefix — the whole D-step and G-step are each
+one jitted XLA program.
+
+``--data uniform`` reproduces gan_conf.py (2-D uniform toy data, fc nets);
+``--data mnist`` reproduces gan_conf_image.py's MNIST image GAN at mlp scale.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import activation as A
+from paddle_tpu import data_type as dt
+from paddle_tpu import layer as L
+from paddle_tpu import optimizer as opt
+from paddle_tpu.dataset import mnist
+from paddle_tpu.topology import Topology
+
+_EPS = 1e-8
+
+
+def build(noise_dim, data_dim, hidden):
+    """Generator z->x and discriminator x->p(real), with name prefixes
+    "gen_"/"dis_" (same convention as gan_conf.py's import_prefix)."""
+    z = L.data(name="noise", type=dt.dense_vector(noise_dim))
+    g_h1 = L.fc(input=z, size=hidden, act=A.Relu(), name="gen_h1")
+    g_h2 = L.fc(input=g_h1, size=hidden, act=A.Relu(), name="gen_h2")
+    fake = L.fc(input=g_h2, size=data_dim, act=None, name="gen_out")
+
+    x = L.data(name="sample", type=dt.dense_vector(data_dim))
+    d_h1 = L.fc(input=x, size=hidden, act=A.Relu(), name="dis_h1")
+    d_h2 = L.fc(input=d_h1, size=hidden, act=A.Relu(), name="dis_h2")
+    prob = L.fc(input=d_h2, size=1, act=A.Sigmoid(), name="dis_out")
+    return Topology(fake), Topology(prob), fake.name, prob.name
+
+
+def split(params):
+    gen = {k: v for k, v in params.items() if k.startswith("gen_")}
+    dis = {k: v for k, v in params.items() if k.startswith("dis_")}
+    return gen, dis
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", choices=("uniform", "mnist"), default="uniform")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-iters", type=int, default=600)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.batch_size, args.num_iters = 32, 20
+
+    if args.data == "uniform":
+        noise_dim, data_dim, hidden = 10, 2, 64
+
+        def real_batch(rng, n):
+            # two-cluster 2-D data, like gan_conf.py's uniform demo
+            c = rng.randint(0, 2, size=(n, 1)).astype(np.float32)
+            return (c * 2.0 - 1.0) + rng.randn(n, 2).astype(np.float32) * 0.1
+    else:
+        noise_dim, data_dim, hidden = 100, mnist.IMAGE_DIM, 256
+        images = np.stack([s[0] for _, s in zip(range(4096),
+                                                mnist.train()())])
+
+        def real_batch(rng, n):
+            return images[rng.randint(0, len(images), size=n)]
+
+    gen_topo, dis_topo, fake_name, prob_name = build(noise_dim, data_dim,
+                                                     hidden)
+    key = jax.random.PRNGKey(0)
+    params = dict(gen_topo.init_params(key))
+    params.update(dis_topo.init_params(jax.random.fold_in(key, 1)))
+
+    g_opt = opt.Adam(learning_rate=2e-4, beta1=0.5)
+    d_opt = opt.Adam(learning_rate=2e-4, beta1=0.5)
+    gen0, dis0 = split(params)
+    g_state, d_state = g_opt.init_state(gen0), d_opt.init_state(dis0)
+
+    def generate(params, noise):
+        values, _ = gen_topo.apply(params, {"noise": noise}, mode="test")
+        return values[fake_name]
+
+    def discriminate(params, x):
+        values, _ = dis_topo.apply(params, {"sample": x}, mode="test")
+        return values[prob_name].reshape(-1)
+
+    @jax.jit
+    def d_step(params, d_state, real, noise):
+        gen_p, _ = split(params)
+
+        def loss_fn(dis_p):
+            p = {**gen_p, **dis_p}
+            fake = generate(p, noise)
+            p_real = discriminate(p, real)
+            p_fake = discriminate(p, fake)
+            return -jnp.mean(jnp.log(p_real + _EPS)
+                             + jnp.log(1.0 - p_fake + _EPS))
+
+        _, dis_p = split(params)
+        loss, grads = jax.value_and_grad(loss_fn)(dis_p)
+        new_dis, new_state = d_opt.step(dis_p, grads, d_state)
+        return {**gen_p, **new_dis}, new_state, loss
+
+    @jax.jit
+    def g_step(params, g_state, noise):
+        _, dis_p = split(params)
+
+        def loss_fn(gen_p):
+            p = {**gen_p, **dis_p}
+            return -jnp.mean(jnp.log(
+                discriminate(p, generate(p, noise)) + _EPS))
+
+        gen_p, _ = split(params)
+        loss, grads = jax.value_and_grad(loss_fn)(gen_p)
+        new_gen, new_state = g_opt.step(gen_p, grads, g_state)
+        return {**new_gen, **dis_p}, new_state, loss
+
+    rng = np.random.RandomState(0)
+    for it in range(args.num_iters):
+        real = real_batch(rng, args.batch_size)
+        noise = rng.randn(args.batch_size, noise_dim).astype(np.float32)
+        params, d_state, d_loss = d_step(params, d_state, real, noise)
+        noise = rng.randn(args.batch_size, noise_dim).astype(np.float32)
+        params, g_state, g_loss = g_step(params, g_state, noise)
+        if it % 50 == 0 or it == args.num_iters - 1:
+            print("iter %d d_loss %.4f g_loss %.4f"
+                  % (it, float(d_loss), float(g_loss)))
+
+    samples = np.asarray(generate(
+        params, jnp.asarray(rng.randn(8, noise_dim), jnp.float32)))
+    if args.data == "uniform":
+        print("generated samples:\n", np.round(samples, 3))
+    else:
+        print("generated image stats: mean %.3f std %.3f"
+              % (samples.mean(), samples.std()))
+    return float(d_loss), float(g_loss)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""MNIST image classification demo (reference: v1_api_demo/mnist/api_train.py
++ light_mnist.py / vgg_16_mnist.py configs).
+
+Trains LeNet (default) or VGG-16 on MNIST, reports test classification error
+per pass, and saves parameters to a tar checkpoint.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu as paddle
+from paddle_tpu import activation as A
+from paddle_tpu import data_type as dt
+from paddle_tpu import evaluator, layer as L, minibatch, optimizer as opt
+from paddle_tpu.dataset import mnist
+from paddle_tpu.models import vision
+from paddle_tpu.networks import vgg_16_network
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.reader import decorator as reader_ops
+
+
+def build(model):
+    img = L.data(name="pixel", type=dt.dense_vector(mnist.IMAGE_DIM))
+    label = L.data(name="label", type=dt.integer_value(mnist.NUM_CLASSES))
+    if model == "lenet":
+        out = vision.lenet(img=img, num_classes=mnist.NUM_CLASSES)
+    elif model == "mlp":
+        out = vision.mlp(img=img, num_classes=mnist.NUM_CLASSES)
+    elif model == "vgg":
+        img.out_img_shape = (1, 28, 28)
+        out = vgg_16_network(img, num_channels=1,
+                             num_classes=mnist.NUM_CLASSES)
+    else:
+        raise ValueError(model)
+    cost = L.classification_cost(input=out, label=label)
+    return img, label, out, cost
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=("lenet", "mlp", "vgg"),
+                    default="lenet")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-passes", type=int, default=5)
+    ap.add_argument("--save", default="mnist_params.tar")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny run for smoke tests")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        args.batch_size, args.num_passes = 32, 1
+        train_reader = reader_ops.firstn(mnist.train(), 128)
+        test_reader = reader_ops.firstn(mnist.test(), 64)
+    else:
+        train_reader = reader_ops.shuffle(mnist.train(), buf_size=8192)
+        test_reader = mnist.test()
+
+    img, label, out, cost = build(args.model)
+    params = Parameters.create(cost)
+    err = evaluator.classification_error(input=out, label=label)
+    trainer = paddle.trainer.SGD(
+        cost, params,
+        opt.Momentum(learning_rate=0.05 / args.batch_size, momentum=0.9),
+        extra_layers=[err])
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndIteration):
+            if event.batch_id % 50 == 0:
+                print("pass %d batch %d cost %.4f"
+                      % (event.pass_id, event.batch_id, event.cost))
+        elif isinstance(event, paddle.event.EndPass):
+            result = trainer.test(minibatch.batch(test_reader,
+                                                  args.batch_size))
+            print("pass %d test error %.4f"
+                  % (event.pass_id, result.metrics[err.name]))
+
+    trainer.train(minibatch.batch(train_reader, args.batch_size),
+                  num_passes=args.num_passes, event_handler=handler)
+
+    if args.save:
+        with open(args.save, "wb") as f:
+            trainer.save_parameter_to_tar(f)
+        print("saved parameters to", args.save)
+
+    # inference smoke: predict the first 8 test digits
+    samples = [(s[0],) for _, s in zip(range(8), test_reader())]
+    probs = paddle.inference.infer(out, params, samples,
+                                   feeding={"pixel": 0})
+    print("predictions:", probs.argmax(axis=1).tolist())
+
+
+if __name__ == "__main__":
+    main()

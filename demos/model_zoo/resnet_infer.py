@@ -1,0 +1,62 @@
+"""Model-zoo ResNet demo (reference: v1_api_demo/model_zoo/resnet —
+classification + intermediate-feature extraction from a pretrained net).
+
+Builds ResNet (default depth 18 for speed; 50/101 supported), optionally
+loads a tar checkpoint, classifies a batch of images, and extracts the
+pre-logit pooled features — the reference's `extract_fea_py` flow.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import vision
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.topology import Topology
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=18,
+                    choices=(18, 34, 50, 101, 152))
+    ap.add_argument("--params", default="",
+                    help="tar checkpoint to load (random init otherwise)")
+    ap.add_argument("--im-size", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--classes", type=int, default=100)
+    args = ap.parse_args(argv)
+
+    out = vision.resnet(depth=args.depth, num_classes=args.classes,
+                        im_size=args.im_size)
+    params = Parameters.create(out)
+    if args.params:
+        with open(args.params, "rb") as f:
+            params.init_from_tar(f)
+
+    rng = np.random.RandomState(0)
+    images = rng.randn(args.batch,
+                       3 * args.im_size * args.im_size).astype(np.float32)
+
+    probs = paddle.inference.infer(out, params,
+                                   [(im,) for im in images],
+                                   feeding={"image": 0})
+    print("top-1 classes:", probs.argmax(axis=1).tolist())
+
+    # feature extraction: the global-average-pool layer feeding the logits
+    topo = Topology(out)
+    feat_layer = [n.name for n in topo.nodes if "pool" in n.name][-1]
+    feed = {"image": images}
+    values, _ = topo.apply(params.as_dict(), feed, mode="test",
+                           outputs=[feat_layer])
+    feats = np.asarray(values[feat_layer]).reshape(args.batch, -1)
+    print("features from %s: shape %s, norm %.3f"
+          % (feat_layer, feats.shape, np.linalg.norm(feats, axis=1).mean()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Quick-start text classification demo (reference: v1_api_demo/quick_start
+api_train.py with trainer_config.{lr,cnn,lstm}.py).
+
+Sentiment classification over the IMDB schema: bag-of-words logistic
+regression, text CNN, or LSTM.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu as paddle
+from paddle_tpu import evaluator, layer as L, minibatch, optimizer as opt
+from paddle_tpu import data_type as dt
+from paddle_tpu.dataset import imdb
+from paddle_tpu.models import text
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.reader import decorator as reader_ops
+
+
+def build(model, dict_size):
+    if model == "lr":
+        out = text.text_classification_lr(dict_size=dict_size)
+        label = L.data(name="label", type=dt.integer_value(2))
+    elif model == "cnn":
+        out = text.text_classification_cnn(dict_size=dict_size)
+        label = L.data(name="label", type=dt.integer_value(2))
+    elif model == "lstm":
+        out = text.text_classification_lstm(dict_size=dict_size)
+        label = L.data(name="label", type=dt.integer_value(2))
+    else:
+        raise ValueError(model)
+    cost = L.classification_cost(input=out, label=label)
+    return label, out, cost
+
+
+def to_bow(dict_size):
+    """LR consumes sparse bag-of-words instead of a word sequence
+    (reference: dataprovider_bow.py vs dataprovider_emb.py)."""
+    def mapper(sample):
+        words, label = sample
+        return sorted(set(int(w) % dict_size for w in words)), label
+
+    return mapper
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=("lr", "cnn", "lstm"), default="lstm")
+    ap.add_argument("--dict-size", type=int, default=5000)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-passes", type=int, default=3)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+
+    word_idx = imdb.word_dict(args.dict_size)
+    train_reader = imdb.train(word_idx)
+    test_reader = imdb.test(word_idx)
+    if args.quick:
+        args.batch_size, args.num_passes = 16, 1
+        train_reader = reader_ops.firstn(train_reader, 64)
+        test_reader = reader_ops.firstn(test_reader, 32)
+
+    if args.model == "lr":
+        train_reader = reader_ops.map_readers(to_bow(args.dict_size),
+                                              train_reader)
+        test_reader = reader_ops.map_readers(to_bow(args.dict_size),
+                                             test_reader)
+
+    label, out, cost = build(args.model, args.dict_size)
+    params = Parameters.create(cost)
+    err = evaluator.classification_error(input=out, label=label)
+    trainer = paddle.trainer.SGD(
+        cost, params, opt.Adam(learning_rate=2e-3), extra_layers=[err])
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndIteration) \
+                and event.batch_id % 25 == 0:
+            print("pass %d batch %d cost %.4f"
+                  % (event.pass_id, event.batch_id, event.cost))
+        elif isinstance(event, paddle.event.EndPass):
+            result = trainer.test(minibatch.batch(test_reader,
+                                                  args.batch_size))
+            print("pass %d test error %.4f"
+                  % (event.pass_id, result.metrics[err.name]))
+
+    trainer.train(minibatch.batch(train_reader, args.batch_size),
+                  num_passes=args.num_passes, event_handler=handler)
+
+    # predict parity (api_predict.py): class probabilities for a few samples
+    samples = [(s[0],) for _, s in zip(range(4), test_reader())]
+    probs = paddle.inference.infer(out, params, samples,
+                                   feeding={"word": 0})
+    for i, p in enumerate(probs):
+        print("sample %d: negative %.3f positive %.3f" % (i, p[0], p[1]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Sequence tagging demo (reference: v1_api_demo/sequence_tagging
+linear_crf.py / rnn_crf.py over CoNLL-05 SRL data).
+
+Two models: linear CRF over embedded context features, or BiLSTM + CRF.
+Reports per-token tagging error from the CRF decoder each pass.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import data_type as dt
+from paddle_tpu import layer as L, minibatch, optimizer as opt
+from paddle_tpu.dataset import conll05
+from paddle_tpu.models import text
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.reader import decorator as reader_ops
+
+NUM_LABELS = 67
+
+
+def build(model, word_dict_size, label_dict_size):
+    label = L.data(name="label",
+                   type=dt.integer_value_sequence(label_dict_size))
+    if model == "linear_crf":
+        words = L.data(name="word",
+                       type=dt.integer_value_sequence(word_dict_size))
+        emb = L.embedding(input=words, size=64, name="lin_emb")
+        ctx = L.context_projection_layer(input=emb, context_start=-2,
+                                         context_len=5, name="lin_ctx")
+        scores = L.fc(input=ctx, size=label_dict_size, act=None,
+                      name="lin_scores")
+    elif model == "rnn_crf":
+        scores = text.sequence_tagging_rnn(
+            word_dict_size=word_dict_size, label_dict_size=label_dict_size)
+    else:
+        raise ValueError(model)
+    cost = L.crf(input=scores, label=label, size=label_dict_size,
+                 name="crf_cost")
+    decoded = L.crf_decoding(input=scores, size=label_dict_size,
+                             param_attr=paddle.attr.ParamAttr(
+                                 name="crf_cost.w0"),
+                             name="crf_decoded")
+    return label, scores, cost, decoded
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=("linear_crf", "rnn_crf"),
+                    default="rnn_crf")
+    ap.add_argument("--dict-size", type=int, default=5000)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-passes", type=int, default=3)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+
+    train_reader = conll05.train()
+    test_reader = conll05.test()
+    if args.quick:
+        args.batch_size, args.num_passes = 8, 1
+        train_reader = reader_ops.firstn(train_reader, 32)
+        test_reader = reader_ops.firstn(test_reader, 16)
+
+    label, scores, cost, decoded = build(args.model, args.dict_size,
+                                         NUM_LABELS)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Adam(learning_rate=2e-3))
+
+    def tag_error(reader):
+        """Per-token error of the Viterbi decode (reference: the demo's
+        chunk evaluator role, simplified to token accuracy)."""
+        wrong = total = 0
+        for batch in reader():
+            samples = [(s[0],) for s in batch]
+            paths = paddle.inference.infer(decoded, params, samples,
+                                           feeding={"word": 0})
+            for (words, labels), path in zip(batch, paths):
+                t = len(labels)
+                pred = np.asarray(path[:t])
+                wrong += int((pred != np.asarray(labels)).sum())
+                total += t
+        return wrong / max(total, 1)
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndIteration) \
+                and event.batch_id % 25 == 0:
+            print("pass %d batch %d cost %.4f"
+                  % (event.pass_id, event.batch_id, event.cost))
+        elif isinstance(event, paddle.event.EndPass):
+            err = tag_error(minibatch.batch(test_reader, args.batch_size))
+            print("pass %d token error %.4f" % (event.pass_id, err))
+
+    trainer.train(minibatch.batch(train_reader, args.batch_size),
+                  num_passes=args.num_passes, event_handler=handler)
+
+
+if __name__ == "__main__":
+    main()

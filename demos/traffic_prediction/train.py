@@ -1,0 +1,90 @@
+"""Traffic-speed prediction demo (reference: v1_api_demo/traffic_prediction
+trainer_config.py — GRU regression over road-sensor time series).
+
+Synthetic sensor data with daily periodicity; a GRU reads a window of
+speeds and predicts the next reading per sensor. Reports test RMSE.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import data_type as dt
+from paddle_tpu import layer as L, minibatch, networks, optimizer as opt
+from paddle_tpu.parameters import Parameters
+
+WINDOW = 24
+SENSORS = 4
+
+
+def make_reader(n, seed):
+    """Speed curves: per-sensor phase-shifted daily sine + noise."""
+    def reader():
+        rng = np.random.RandomState(seed)
+        phases = rng.uniform(0, 2 * np.pi, SENSORS)
+        for _ in range(n):
+            t0 = rng.uniform(0, 2 * np.pi)
+            ts = t0 + np.arange(WINDOW + 1) * (2 * np.pi / 24.0)
+            speeds = (np.sin(ts[:, None] + phases[None, :]) * 0.5
+                      + rng.randn(WINDOW + 1, SENSORS) * 0.05)
+            yield (speeds[:WINDOW].astype(np.float32),
+                   speeds[WINDOW].astype(np.float32))
+
+    return reader
+
+
+def build():
+    seq = L.data(name="speeds",
+                 type=dt.dense_vector_sequence(SENSORS))
+    target = L.data(name="target", type=dt.dense_vector(SENSORS))
+    gru = networks.simple_gru(input=seq, size=64, name="traffic_gru")
+    last = L.last_seq(input=gru)
+    pred = L.fc(input=last, size=SENSORS, act=None, name="traffic_out")
+    cost = L.square_error_cost(input=pred, label=target)
+    return target, pred, cost
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-passes", type=int, default=5)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+    n_train, n_test = (64, 32) if args.quick else (2048, 256)
+    if args.quick:
+        args.num_passes = 1
+
+    target, pred, cost = build()
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Adam(learning_rate=2e-3))
+
+    test_reader = make_reader(n_test, seed=99)
+
+    def rmse():
+        errs = []
+        for batch in minibatch.batch(test_reader, args.batch_size)():
+            out = paddle.inference.infer(pred, params,
+                                         [(s[0],) for s in batch],
+                                         feeding={"speeds": 0})
+            gold = np.stack([s[1] for s in batch])
+            errs.append(((out - gold) ** 2).mean())
+        return float(np.sqrt(np.mean(errs)))
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndPass):
+            print("pass %d test RMSE %.4f" % (event.pass_id, rmse()))
+
+    trainer.train(minibatch.batch(make_reader(n_train, seed=0),
+                                  args.batch_size),
+                  num_passes=args.num_passes, event_handler=handler)
+    return rmse()
+
+
+if __name__ == "__main__":
+    main()

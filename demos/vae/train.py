@@ -1,0 +1,110 @@
+"""VAE demo (reference: v1_api_demo/vae vae_conf.py + vae_train.py).
+
+MNIST variational autoencoder: fc encoder to (mu, log-variance),
+reparameterized gaussian sample, fc decoder; loss = reconstruction
+binary cross-entropy + KL(q(z|x) || N(0,1)). Encoder, sampling, decoder
+and both loss terms run inside one jitted program — the reparameterization
+trick is just jnp arithmetic between two Topology applies.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import activation as A
+from paddle_tpu import data_type as dt
+from paddle_tpu import layer as L
+from paddle_tpu import optimizer as opt
+from paddle_tpu.dataset import mnist
+from paddle_tpu.topology import Topology
+
+_EPS = 1e-7
+
+
+def build(data_dim, hidden, latent):
+    x = L.data(name="image", type=dt.dense_vector(data_dim))
+    e_h = L.fc(input=x, size=hidden, act=A.Tanh(), name="enc_h")
+    mu = L.fc(input=e_h, size=latent, act=None, name="enc_mu")
+    logvar = L.fc(input=e_h, size=latent, act=None, name="enc_logvar")
+
+    z = L.data(name="z", type=dt.dense_vector(latent))
+    d_h = L.fc(input=z, size=hidden, act=A.Tanh(), name="dec_h")
+    recon = L.fc(input=d_h, size=data_dim, act=A.Sigmoid(), name="dec_out")
+    return Topology([mu, logvar]), Topology(recon)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-iters", type=int, default=400)
+    ap.add_argument("--latent", type=int, default=16)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.batch_size, args.num_iters = 32, 15
+        args.hidden = 64
+
+    enc_topo, dec_topo = build(mnist.IMAGE_DIM, args.hidden, args.latent)
+    key = jax.random.PRNGKey(0)
+    params = dict(enc_topo.init_params(key))
+    params.update(dec_topo.init_params(jax.random.fold_in(key, 1)))
+
+    optimizer = opt.Adam(learning_rate=1e-3)
+    opt_state = optimizer.init_state(params)
+
+    def elbo_loss(params, x01, rng):
+        enc, _ = enc_topo.apply(params, {"image": x01}, mode="test")
+        mu, logvar = enc["enc_mu"], enc["enc_logvar"]
+        eps = jax.random.normal(rng, mu.shape, mu.dtype)
+        z = mu + jnp.exp(0.5 * logvar) * eps
+        dec, _ = dec_topo.apply(params, {"z": z}, mode="test")
+        recon = dec["dec_out"]
+        bce = -jnp.sum(x01 * jnp.log(recon + _EPS)
+                       + (1.0 - x01) * jnp.log(1.0 - recon + _EPS), axis=1)
+        kl = -0.5 * jnp.sum(1.0 + logvar - mu ** 2 - jnp.exp(logvar), axis=1)
+        return jnp.mean(bce + kl)
+
+    @jax.jit
+    def train_step(params, opt_state, x01, rng):
+        loss, grads = jax.value_and_grad(elbo_loss)(params, x01, rng)
+        new_params, new_state = optimizer.step(params, grads, opt_state)
+        return new_params, new_state, loss
+
+    images = np.stack([s[0] for _, s in zip(range(4096 if not args.quick
+                                                  else 256),
+                                            mnist.train()())])
+    images01 = (images + 1.0) / 2.0  # dataset is [-1,1]; BCE wants [0,1]
+
+    rng = np.random.RandomState(0)
+    key = jax.random.PRNGKey(42)
+    first = last = None
+    for it in range(args.num_iters):
+        batch = images01[rng.randint(0, len(images01),
+                                     size=args.batch_size)]
+        key, sub = jax.random.split(key)
+        params, opt_state, loss = train_step(params, opt_state,
+                                             jnp.asarray(batch), sub)
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+        if it % 50 == 0 or it == args.num_iters - 1:
+            print("iter %d elbo-loss %.2f" % (it, float(loss)))
+
+    # decode a few prior samples (vae_train.py's sampling stage)
+    z = jax.random.normal(jax.random.PRNGKey(7), (8, args.latent))
+    dec, _ = dec_topo.apply(params, {"z": z}, mode="test")
+    samples = np.asarray(dec["dec_out"])
+    print("decoded sample stats: mean %.3f std %.3f"
+          % (samples.mean(), samples.std()))
+    return first, last
+
+
+if __name__ == "__main__":
+    main()

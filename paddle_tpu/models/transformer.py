@@ -37,7 +37,7 @@ class ParallelTransformer:
     def __init__(self, mesh, vocab=128, emb=16, heads=4, classes=4,
                  n_micro=2, data_axis="data", model_axis="model",
                  pipe_axis="pipe", attention="ring"):
-        enforce(emb % heads == 0, "emb %d must divide heads %d", emb, heads)
+        enforce(emb % heads == 0, "heads %d must divide emb %d", heads, emb)
         enforce(attention in ("ring", "ulysses"),
                 "unknown attention strategy %r", attention)
         self.mesh = mesh
@@ -119,7 +119,7 @@ class ParallelTransformer:
         x = x + jnp.einsum("ble,ef->blf", attn, params["proj_w"])
         # pipelined residual MLP stack (pp)
         enforce(b % self.n_micro == 0,
-                "batch %d must divide microbatches %d", b, self.n_micro)
+                "microbatch count %d must divide batch %d", self.n_micro, b)
         mb = b // self.n_micro
         xs = x.reshape(self.n_micro, mb, l, e)
 

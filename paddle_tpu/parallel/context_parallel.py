@@ -110,7 +110,7 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None,
     enforce(isinstance(mesh, Mesh), "ring_attention needs a jax Mesh")
     axis_size = mesh.shape[seq_axis]
     enforce(q.shape[1] % axis_size == 0,
-            "seq len %d must divide seq axis %d", q.shape[1], axis_size)
+            "seq axis size %d must divide seq len %d", axis_size, q.shape[1])
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     spec = P(batch_axis, seq_axis, None, None)
@@ -148,9 +148,9 @@ def ulysses_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None,
     enforce(isinstance(mesh, Mesh), "ulysses_attention needs a jax Mesh")
     axis_size = mesh.shape[seq_axis]
     enforce(q.shape[1] % axis_size == 0,
-            "seq len %d must divide seq axis %d", q.shape[1], axis_size)
+            "seq axis size %d must divide seq len %d", axis_size, q.shape[1])
     enforce(q.shape[2] % axis_size == 0,
-            "num heads %d must divide seq axis %d", q.shape[2], axis_size)
+            "seq axis size %d must divide num heads %d", axis_size, q.shape[2])
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     spec = P(batch_axis, seq_axis, None, None)
@@ -181,6 +181,9 @@ class SequenceParallel:
                   scale=scale, batch_axis=self.batch_axis)
 
     def shard_sequence(self, x):
-        """Place a [B, L, ...] host array with L sharded on the seq axis."""
-        spec = P(*([None, self.seq_axis] + [None] * (x.ndim - 2)))
+        """Place a [B, L, ...] host array with L sharded on the seq axis
+        (and B on ``batch_axis`` when configured, matching __call__'s
+        in_specs so no resharding happens on the hot path)."""
+        spec = P(*([self.batch_axis, self.seq_axis]
+                   + [None] * (x.ndim - 2)))
         return jax.device_put(x, NamedSharding(self.mesh, spec))

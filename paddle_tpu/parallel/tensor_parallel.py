@@ -48,7 +48,7 @@ def megatron_dense_pair(x, w1, b1, w2, b2, mesh, axis="model",
     enforce(isinstance(mesh, Mesh), "megatron_dense_pair needs a jax Mesh")
     n = mesh.shape[axis]
     enforce(w1.shape[1] % n == 0,
-            "hidden dim %d must divide tp axis %d", w1.shape[1], n)
+            "tp axis size %d must divide hidden dim %d", n, w1.shape[1])
     lead = (batch_axis,) + (None,) * (x.ndim - 2)
     x_spec = P(*lead, None)
     body = functools.partial(_pair_shard, axis_name=axis, act=act)
