@@ -25,6 +25,7 @@ class ParamAttr:
         momentum=None,
         gradient_clipping_threshold=None,
         sparse_update=False,
+        update_hooks=None,
     ):
         self.name = name
         self.is_static = is_static
@@ -37,6 +38,10 @@ class ParamAttr:
         self.momentum = momentum
         self.gradient_clipping_threshold = gradient_clipping_threshold
         self.sparse_update = sparse_update
+        # post-update hooks, e.g. HookAttribute/StaticPruningHook parity
+        # (reference: parameter/ParameterUpdaterHook.cpp) — objects with
+        # init_mask(param) and apply(param) -> param
+        self.update_hooks = update_hooks
 
     @staticmethod
     def to_attr(arg):

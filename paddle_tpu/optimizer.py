@@ -63,7 +63,7 @@ class Optimizer:
     def __init__(self, learning_rate=1e-3, regularization=None,
                  gradient_clipping_threshold=None, model_average=None,
                  learning_rate_decay_a=0.0, learning_rate_decay_b=0.0,
-                 learning_rate_schedule="constant"):
+                 learning_rate_schedule="constant", sparse=False):
         self.lr_fn = make_lr_schedule(
             learning_rate, learning_rate_decay_a, learning_rate_decay_b,
             learning_rate_schedule)
@@ -72,6 +72,11 @@ class Optimizer:
         if model_average is not None and not isinstance(model_average, float):
             model_average = model_average.decay
         self.model_average = model_average
+        # sparse-row mode: rows with all-zero gradient are skipped entirely
+        # (no slot decay, no regularization) and regularization is caught up
+        # lazily when a row is next touched (reference: SparseMomentum
+        # FirstOrderOptimizer.h:40 + ThreadParameterUpdater catchUpWith)
+        self.sparse = bool(sparse)
 
     # slots ------------------------------------------------------------------
     def init_slot(self, param):
@@ -84,14 +89,59 @@ class Optimizer:
         raise NotImplementedError
 
     # full-step --------------------------------------------------------------
-    def init_state(self, params):
+    def _is_sparse_param(self, attr):
+        return self.sparse or bool(getattr(attr, "sparse_update", False))
+
+    def init_state(self, params, param_meta=None):
+        param_meta = param_meta or {}
         state = {
             "step": jnp.zeros((), jnp.int32),
             "slots": {k: self.init_slot(v) for k, v in params.items()},
         }
+        for k, v in params.items():
+            for hook in getattr(param_meta.get(k), "update_hooks", None) or ():
+                hook.init_mask(k, v)
+        row_step = {
+            k: jnp.zeros((v.shape[0],), jnp.int32)
+            for k, v in params.items()
+            if v.ndim >= 1 and self._is_sparse_param(param_meta.get(k))
+        }
+        if row_step:
+            state["row_step"] = row_step
         if self.model_average:
             state["average"] = {k: jnp.asarray(v) for k, v in params.items()}
         return state
+
+    def _sparse_row_step(self, grad, slot, param, lr, l1, l2, last_step,
+                         step_no):
+        """Update only rows touched this batch; catch up the L1/L2 decay
+        the row missed while dormant (reference: SparseRowCpuMatrix row
+        lifecycle + catchUpWith — the decay for the missed steps is applied
+        in one shot, same first-order approximation the reference uses)."""
+        touched = jnp.any(grad != 0, axis=tuple(range(1, grad.ndim)))
+        mask = touched.reshape((-1,) + (1,) * (grad.ndim - 1))
+        missed = (step_no - last_step).astype(param.dtype)
+        missed_col = missed.reshape(mask.shape)
+        p = param
+        if l2:
+            p = p * jnp.power(1.0 - lr * l2, jnp.where(mask, missed_col, 0.0))
+        if l1:
+            shrunk = jnp.sign(p) * jnp.maximum(
+                jnp.abs(p) - lr * l1 * missed_col, 0.0)
+            p = jnp.where(mask, shrunk, p)
+        delta, new_slot = self.apply_update(grad, slot, p, lr)
+        new_param = jnp.where(mask, p + delta, param)
+
+        def keep_untouched(ns, os):
+            # only per-row slots (leading dim = rows) are masked; global
+            # slots like Adam's scalar step counter always advance
+            if getattr(ns, "ndim", 0) >= 1 and ns.shape[0] == mask.shape[0]:
+                return jnp.where(mask, ns, os)
+            return ns
+
+        new_slot = jax.tree.map(keep_untouched, new_slot, slot)
+        new_last = jnp.where(touched, step_no, last_step)
+        return new_param, new_slot, new_last
 
     def step(self, params, grads, state, param_meta=None):
         """Apply one update. ``param_meta``: {name: ParamAttr} for per-param
@@ -101,6 +151,8 @@ class Optimizer:
         step_no = state["step"] + 1
         lr_t = self.lr_fn(step_no.astype(jnp.float32))
         new_params, new_slots = {}, {}
+        row_steps = state.get("row_step", {})
+        new_row_steps = {}
         avg = state.get("average")
         new_avg = {} if avg is not None else None
         for name, param in params.items():
@@ -117,21 +169,32 @@ class Optimizer:
             if self.regularization is not None:
                 l1 = self.regularization.l1 if l1 is None else l1
                 l2 = self.regularization.l2 if l2 is None else l2
-            if l2:
-                grad = grad + l2 * param
             lr = lr_t * lr_mult
-            delta, new_slot = self.apply_update(grad, state["slots"][name], param, lr)
-            new_param = param + delta
-            if l1:
-                # proximal L1 shrinkage (reference: L1Regularizer::update)
-                new_param = jnp.sign(new_param) * jnp.maximum(
-                    jnp.abs(new_param) - lr * l1, 0.0)
+            if name in row_steps:
+                new_param, new_slot, new_last = self._sparse_row_step(
+                    grad, state["slots"][name], param, lr, l1, l2,
+                    row_steps[name], step_no)
+                new_row_steps[name] = new_last
+            else:
+                if l2:
+                    grad = grad + l2 * param
+                delta, new_slot = self.apply_update(
+                    grad, state["slots"][name], param, lr)
+                new_param = param + delta
+                if l1:
+                    # proximal L1 shrinkage (reference: L1Regularizer::update)
+                    new_param = jnp.sign(new_param) * jnp.maximum(
+                        jnp.abs(new_param) - lr * l1, 0.0)
+            for hook in getattr(attr, "update_hooks", None) or ():
+                new_param = hook.apply(name, new_param)
             new_params[name] = new_param
             new_slots[name] = new_slot
             if new_avg is not None:
                 decay = self.model_average
                 new_avg[name] = decay * avg[name] + (1.0 - decay) * new_param
         new_state = {"step": step_no, "slots": new_slots}
+        if new_row_steps:
+            new_state["row_step"] = new_row_steps
         if new_avg is not None:
             new_state["average"] = new_avg
         return new_params, new_state
@@ -142,7 +205,7 @@ class Momentum(Optimizer):
     SparseMomentumParameterOptimizer; v2 optimizer.Momentum)."""
 
     def __init__(self, momentum=0.0, sparse=False, nesterov=False, **kw):
-        super().__init__(**kw)
+        super().__init__(sparse=sparse, **kw)
         self.mu = float(momentum)
         self.nesterov = nesterov
 
@@ -308,3 +371,33 @@ class ModelAverage:
 
     def __init__(self, average_window=0.999):
         self.decay = float(average_window)
+
+
+class StaticPruningHook:
+    """Static magnitude pruning (reference: ParameterUpdaterHook.cpp
+    StaticPruningHook, attached via ParamAttr(update_hooks=...)): a mask
+    zeroing the smallest ``sparsity_ratio`` fraction of |w| is computed
+    once from the initial values and re-applied after every update. The
+    mask is a jit-time constant, so the masked update fuses into the
+    optimizer's XLA program."""
+
+    def __init__(self, sparsity_ratio=0.6):
+        self.sparsity_ratio = float(sparsity_ratio)
+        self._masks = {}
+
+    def init_mask(self, name, param):
+        import numpy as np
+
+        flat = np.abs(np.asarray(param)).reshape(-1)
+        k = int(flat.size * self.sparsity_ratio)
+        mask = np.ones_like(flat)
+        if k > 0:
+            # mask exactly k elements (ties broken by index) so constant
+            # initializations aren't zeroed wholesale
+            mask[np.argpartition(flat, k - 1)[:k]] = 0.0
+        self._masks[name] = jnp.asarray(mask.reshape(param.shape))
+        return self._masks[name]
+
+    def apply(self, name, param):
+        mask = self._masks.get(name)
+        return param if mask is None else param * mask

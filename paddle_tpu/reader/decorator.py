@@ -120,6 +120,36 @@ def buffered(reader, size):
     return buffered_reader
 
 
+def mix_readers(readers, ratios=None, seed=None):
+    """Interleave several readers with given sampling ratios (reference:
+    MultiDataProvider, gserver/dataproviders/MultiDataProvider.cpp — mixes
+    sub-providers proportionally to their configured ratios). Draws from
+    each reader with probability ratio_i / sum(ratios); a reader that runs
+    dry is dropped and the remaining ratios renormalize. Ends when all
+    readers are exhausted."""
+    ratios = list(ratios) if ratios is not None else [1.0] * len(readers)
+    if len(ratios) != len(readers):
+        raise ValueError("need one ratio per reader")
+
+    def reader():
+        rng = _random.Random(seed)
+        live = [[it, r] for it, r in zip([r() for r in readers], ratios)]
+        while live:
+            total = sum(r for _, r in live)
+            pick = rng.uniform(0.0, total)
+            acc = 0.0
+            for entry in live:
+                acc += entry[1]
+                if pick <= acc:
+                    break
+            try:
+                yield next(entry[0])
+            except StopIteration:
+                live.remove(entry)
+
+    return reader
+
+
 def firstn(reader, n):
     def firstn_reader():
         for i, sample in enumerate(reader()):

@@ -116,7 +116,14 @@ class SGD:
 
         # device-resident training state
         self._materialize_device_state()
-        self._opt_state = optimizer.init_state(self._trainable)
+        self._opt_state = optimizer.init_state(self._trainable,
+                                               self._param_meta)
+        # update hooks prune the initial values too (reference:
+        # StaticPruningHook masks at init, not just per update)
+        for n, attr in self._param_meta.items():
+            for hook in getattr(attr, "update_hooks", None) or ():
+                if n in self._trainable:
+                    self._trainable[n] = hook.apply(n, self._trainable[n])
         self._rng = jax.random.PRNGKey(flags.get_flag("seed") or 0)
         self._step_count = 0
 
@@ -153,6 +160,11 @@ class SGD:
                 if log_period and batch_id % log_period == 0:
                     logger.info("pass %d batch %d cost=%.6f %s", pass_id,
                                 batch_id, float(loss), _fmt_metrics(metrics))
+                    if flags.get_flag("show_layer_stat"):
+                        self._log_layer_stats(feed)
+                psp = flags.get_flag("show_parameter_stats_period")
+                if psp and self._step_count % psp == 0:
+                    self._log_param_stats()
                 event_handler(v2_event.EndIteration(
                     pass_id, batch_id, float(loss), metrics))
                 batch_id += 1
@@ -182,6 +194,28 @@ class SGD:
         metrics = {e.name: e.result(eval_acc[e.name]) for e in self.evaluators}
         return v2_event.TestResult(
             0, total_cost / max(n_batches, 1), metrics)
+
+    # -- observability (Flags.cpp:71 --show_layer_stat;
+    # TrainerInternal.cpp:100-110 --show_param_stats_period) ----------------
+    def _log_layer_stats(self, feed):
+        """Per-layer output mean/|mean|/max, the reference's per-layer
+        debug line, computed from a plain forward on the current batch."""
+        from paddle_tpu.layer.base import data_of
+
+        params = {**self._trainable, **self._static, **self._state}
+        values, _ = self.topology.apply_all(params, feed, mode="test")
+        for name, val in values.items():
+            arr = np.asarray(jax.device_get(data_of(val)))
+            if arr.dtype.kind not in "fc":
+                continue
+            logger.info("layer %s: avg=%.6g absavg=%.6g max=%.6g", name,
+                        arr.mean(), np.abs(arr).mean(), arr.max())
+
+    def _log_param_stats(self):
+        for name, val in self._trainable.items():
+            arr = np.asarray(jax.device_get(val))
+            logger.info("param %s: avg_abs=%.6g max_abs=%.6g", name,
+                        np.abs(arr).mean(), np.abs(arr).max())
 
     # -- state sync ---------------------------------------------------------
     def _materialize_device_state(self):
@@ -251,7 +285,8 @@ class SGD:
                 "model, skipped: %s", len(skipped), skipped[:8])
         self._materialize_device_state()
         if opt_flat is not None:
-            template = self.optimizer.init_state(self._trainable)
+            template = self.optimizer.init_state(self._trainable,
+                                                 self._param_meta)
             self._opt_state = jax.tree_util.tree_map(
                 jnp.asarray,
                 ckpt.unflatten_state(template, opt_flat))

@@ -6,6 +6,7 @@ against a hand-written numpy step)."""
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu import optimizer as opt
@@ -171,3 +172,163 @@ def test_softmax_input_classification_cost_equals_logits_path():
     vals, _ = topo.apply(params, feed, mode="test")
     np.testing.assert_allclose(np.asarray(vals[c1.name]),
                                np.asarray(vals[c2.name]), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# sparse-row updates + catch-up (reference: SparseMomentum
+# FirstOrderOptimizer.h:40; ThreadParameterUpdater catchUpWith)
+# ---------------------------------------------------------------------------
+def test_sparse_rows_untouched_rows_frozen():
+    rng = np.random.RandomState(1)
+    p = {"emb": jnp.asarray(rng.randn(6, 4), jnp.float32)}
+    g = np.zeros((6, 4), np.float32)
+    g[1] = rng.randn(4)
+    g[4] = rng.randn(4)
+    grads = {"emb": jnp.asarray(g)}
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9, sparse=True)
+    state = o.init_state(p)
+    assert "row_step" in state
+    newp, state = o.step(p, grads, state)
+    touched = [1, 4]
+    untouched = [0, 2, 3, 5]
+    np.testing.assert_array_equal(np.asarray(newp["emb"])[untouched],
+                                  np.asarray(p["emb"])[untouched])
+    assert not np.allclose(np.asarray(newp["emb"])[touched],
+                           np.asarray(p["emb"])[touched])
+    # velocity slots frozen for untouched rows
+    vel = np.asarray(state["slots"]["emb"][0])
+    np.testing.assert_array_equal(vel[untouched], 0.0)
+    # row_step records the touch
+    np.testing.assert_array_equal(np.asarray(state["row_step"]["emb"]),
+                                  [0, 1, 0, 0, 1, 0])
+
+
+def test_sparse_l2_catchup_matches_dense_decay():
+    """A row touched at steps 1 and 4 must see the same L2 decay as the
+    dense path would have applied at steps 2,3,4 (grad zero there)."""
+    lr, l2 = 0.1, 0.05
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(1, 3).astype(np.float32)
+    g1 = rng.randn(1, 3).astype(np.float32)
+    g4 = rng.randn(1, 3).astype(np.float32)
+    zero = np.zeros_like(g1)
+
+    def run(sparse):
+        o = opt.Momentum(learning_rate=lr, momentum=0.0, sparse=sparse,
+                         regularization=opt.L2Regularization(rate=l2))
+        p = {"w": jnp.asarray(w0)}
+        state = o.init_state(p)
+        for g in (g1, zero, zero, g4):
+            p, state = o.step(p, {"w": jnp.asarray(g)}, state)
+        return np.asarray(p["w"])
+
+    dense = run(False)
+    sparse = run(True)
+    np.testing.assert_allclose(sparse, dense, rtol=2e-4)
+
+
+def test_sparse_update_via_param_attr():
+    from paddle_tpu.attr import ParamAttr
+
+    p = {"emb": jnp.ones((4, 2)), "w": jnp.ones((2, 2))}
+    meta = {"emb": ParamAttr(sparse_update=True), "w": ParamAttr()}
+    o = opt.AdaGrad(learning_rate=0.1)
+    state = o.init_state(p, meta)
+    assert set(state.get("row_step", {})) == {"emb"}
+    g = {"emb": jnp.zeros((4, 2)), "w": jnp.ones((2, 2))}
+    newp, state = o.step(p, g, state, meta)
+    np.testing.assert_array_equal(np.asarray(newp["emb"]), np.asarray(p["emb"]))
+    assert not np.allclose(np.asarray(newp["w"]), np.asarray(p["w"]))
+
+
+# ---------------------------------------------------------------------------
+# update hooks (reference: ParameterUpdaterHook.cpp StaticPruningHook)
+# ---------------------------------------------------------------------------
+def test_static_pruning_hook():
+    from paddle_tpu.attr import ParamAttr
+
+    rng = np.random.RandomState(3)
+    w = rng.randn(8, 8).astype(np.float32)
+    hook = opt.StaticPruningHook(sparsity_ratio=0.5)
+    p = {"w": jnp.asarray(w)}
+    meta = {"w": ParamAttr(update_hooks=[hook])}
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9)
+    state = o.init_state(p, meta)
+    g = {"w": jnp.asarray(rng.randn(8, 8), jnp.float32)}
+    for _ in range(3):
+        p, state = o.step(p, g, state, meta)
+    out = np.asarray(p["w"])
+    # exactly the pruned half stays zero through updates
+    assert (out == 0).sum() == 32
+    mask = np.asarray(hook._masks["w"])
+    np.testing.assert_array_equal(out[mask == 0], 0.0)
+    assert np.all(out[mask == 1] != 0)
+
+
+def test_sparse_rows_with_adam_keeps_scalar_slot():
+    """Adam's scalar step slot must not be broadcast to per-row shape by
+    the sparse path (keeps opt-state structure stable across steps)."""
+    p = {"emb": jnp.ones((5, 3))}
+    o = opt.Adam(learning_rate=0.1, sparse=True)
+    state = o.init_state(p)
+    shapes0 = jax.tree.map(jnp.shape, state["slots"])
+    g = np.zeros((5, 3), np.float32)
+    g[2] = 1.0
+    for _ in range(2):
+        p, state = o.step(p, {"emb": jnp.asarray(g)}, state)
+    shapes1 = jax.tree.map(jnp.shape, state["slots"])
+    assert shapes0 == shapes1
+    # untouched rows of m/v stay zero
+    m = np.asarray(state["slots"]["emb"][0])
+    assert np.all(m[[0, 1, 3, 4]] == 0) and np.any(m[2] != 0)
+
+
+def test_pruning_hook_constant_param_keeps_ratio():
+    hook = opt.StaticPruningHook(sparsity_ratio=0.25)
+    mask = np.asarray(hook.init_mask("b", jnp.ones((4, 4))))
+    assert (mask == 0).sum() == 4  # exactly k, even with all-tied values
+
+
+def test_checkpoint_restore_preserves_sparse_row_state(tmp_path):
+    from paddle_tpu import layer as L, data_type as dt, minibatch
+    from paddle_tpu import trainer as tr_mod
+    from paddle_tpu.attr import ParamAttr
+    from paddle_tpu.parameters import Parameters
+    import paddle_tpu as paddle
+
+    def build():
+        from paddle_tpu.graph import reset_name_counters
+
+        reset_name_counters()
+        w = L.data(name="w", type=dt.integer_value_sequence(10))
+        y = L.data(name="y", type=dt.integer_value(2))
+        emb = L.embedding(input=w, size=4, name="ck_emb",
+                          param_attr=ParamAttr(name="ck_table",
+                                               sparse_update=True))
+        pooled = L.pooling(input=emb,
+                           pooling_type=paddle.pooling.SumPooling())
+        out = L.fc(input=pooled, size=2)
+        return L.classification_cost(input=out, label=y)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(8):
+            ids = rng.randint(0, 5, size=3)
+            yield ids, int(ids.sum() % 2)
+
+    cost = build()
+    params = Parameters.create(cost)
+    t1 = paddle.trainer.SGD(cost, params,
+                            opt.Momentum(learning_rate=0.1, momentum=0.9))
+    t1.train(minibatch.batch(reader, 4), num_passes=1)
+    t1.save_checkpoint(str(tmp_path), pass_id=0)
+
+    cost2 = build()
+    params2 = Parameters.create(cost2)
+    t2 = paddle.trainer.SGD(cost2, params2,
+                            opt.Momentum(learning_rate=0.1, momentum=0.9))
+    t2.restore_checkpoint(str(tmp_path))
+    assert "row_step" in t2._opt_state
+    np.testing.assert_array_equal(
+        np.asarray(t2._opt_state["row_step"]["ck_table"]),
+        np.asarray(t1._opt_state["row_step"]["ck_table"]))

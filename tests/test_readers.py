@@ -133,3 +133,24 @@ def test_ploter_headless(tmp_path):
     p.plot(path=str(tmp_path / "curve.png"))  # Agg backend or log fallback
     p.reset()
     p.plot()
+
+
+def test_mix_readers_ratios():
+    from paddle_tpu.reader import decorator as dec
+
+    a = lambda: iter(["a"] * 300)
+    b = lambda: iter(["b"] * 300)
+    mixed = dec.mix_readers([a, b], ratios=[3, 1], seed=7)
+    out = [s for _, s in zip(range(200), mixed())]
+    na, nb = out.count("a"), out.count("b")
+    assert na + nb == 200
+    assert 120 < na < 180  # ~3:1 mixing
+
+
+def test_mix_readers_exhaustion():
+    from paddle_tpu.reader import decorator as dec
+
+    a = lambda: iter([1, 2])
+    b = lambda: iter([10, 20, 30, 40])
+    out = list(dec.mix_readers([a, b], seed=0)())
+    assert sorted(out) == [1, 2, 10, 20, 30, 40]

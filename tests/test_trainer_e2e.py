@@ -182,3 +182,71 @@ def test_from_tar_preserves_partition_metadata():
     assert "fz" in static
     assert "bnm.moving_mean" in state and "bnm.moving_var" in state
     assert "fz" not in trainable
+
+
+def test_layer_and_param_stats_logging():
+    import logging
+
+    from paddle_tpu.utils import flags as fl
+    from paddle_tpu.utils.logger import logger as plogger
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture(level=logging.INFO)
+    x, lab, out, cost = _toy_classification_net(dim=4, classes=2)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params, opt.Momentum(learning_rate=0.1))
+    fl.set_flag("show_layer_stat", True)
+    fl.set_flag("show_parameter_stats_period", 1)
+    fl.set_flag("log_period", 1)
+    plogger.addHandler(handler)
+    old_level = plogger.level
+    plogger.setLevel(logging.INFO)
+    try:
+        trainer.train(minibatch.batch(_toy_reader(dim=4, classes=2, n=8), 4),
+                      num_passes=1)
+    finally:
+        plogger.removeHandler(handler)
+        plogger.setLevel(old_level)
+        fl.set_flag("show_layer_stat", False)
+        fl.set_flag("show_parameter_stats_period", 0)
+        fl.set_flag("log_period", 100)
+    text = "\n".join(records)
+    assert "absavg" in text
+    assert "max_abs" in text
+
+
+def test_sparse_embedding_training_only_touches_used_rows():
+    """ParamAttr(sparse_update=True) embedding: rows never fed stay at
+    their initial values (reference: sparse_update embedding semantics)."""
+    from paddle_tpu.attr import ParamAttr
+
+    vocab = 20
+    words = L.data(name="w", type=dt.integer_value_sequence(vocab))
+    lab = L.data(name="y", type=dt.integer_value(2))
+    emb = L.embedding(input=words, size=8, name="semb",
+                      param_attr=ParamAttr(name="semb_table",
+                                           sparse_update=True))
+    pooled = L.pooling(input=emb,
+                       pooling_type=paddle.pooling.SumPooling())
+    out = L.fc(input=pooled, size=2)
+    cost = L.classification_cost(input=out, label=lab)
+    params = Parameters.create(cost)
+    before = params.get("semb_table").copy()
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(20):
+            ids = rng.randint(0, 10, size=5)  # rows 10..19 never touched
+            yield ids, int(ids.sum() % 2)
+
+    trainer = paddle.trainer.SGD(
+        cost, params, opt.Momentum(learning_rate=0.1, momentum=0.9))
+    trainer.train(minibatch.batch(reader, 5), num_passes=2)
+    after = params.get("semb_table")
+    np.testing.assert_array_equal(after[10:], before[10:])
+    assert not np.allclose(after[:10], before[:10])
