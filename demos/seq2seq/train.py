@@ -1,0 +1,75 @@
+"""Seq2seq NMT demo (reference: the seqToseq machine-translation demo
+family — attention encoder-decoder over the WMT-14 schema, beam-search
+generation; RecurrentGradientMachine.h:300 generateSequence).
+
+Trains the attention NMT model on the wmt14 reader schema
+(source, <s>-prefixed target, </s>-suffixed target) and decodes a few
+sources with beam search at the end.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import minibatch, optimizer as opt
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.dataset import wmt14
+from paddle_tpu.models import text
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.reader import decorator as reader_ops
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dict-size", type=int, default=2000)
+    ap.add_argument("--emb", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-passes", type=int, default=3)
+    ap.add_argument("--beam-size", type=int, default=4)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+
+    train_reader = wmt14.train(args.dict_size)
+    if args.quick:
+        args.batch_size, args.num_passes = 8, 1
+        args.emb, args.hidden = 16, 24
+        train_reader = reader_ops.firstn(train_reader, 32)
+
+    cost, make_generator = text.seq2seq_attention(
+        src_dict_size=args.dict_size, trg_dict_size=args.dict_size,
+        emb_size=args.emb, enc_size=args.hidden, dec_size=args.hidden)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Adam(learning_rate=2e-3))
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndIteration) \
+                and event.batch_id % 20 == 0:
+            print("pass %d batch %d cost %.4f"
+                  % (event.pass_id, event.batch_id, event.cost))
+
+    trainer.train(minibatch.batch(train_reader, args.batch_size),
+                  num_passes=args.num_passes, event_handler=handler)
+
+    # beam-search generation (api parity: gen_trans demo flow)
+    gen = make_generator(beam_size=args.beam_size,
+                         max_length=8 if args.quick else 30)
+    sources = [s[0] for _, s in zip(range(3), wmt14.test(args.dict_size)())]
+    seqs, lengths, scores = gen.generate(
+        params,
+        feed={"source_words": SequenceBatch.from_sequences(sources)})
+    for i, src in enumerate(sources):
+        best = seqs[i, 0, :max(int(lengths[i, 0]), 1)]
+        print("src %s -> beam best %s (score %.3f)"
+              % (np.asarray(src).tolist(), best.tolist(),
+                 float(scores[i, 0])))
+
+
+if __name__ == "__main__":
+    main()

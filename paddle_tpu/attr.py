@@ -40,7 +40,7 @@ class ParamAttr:
         self.sparse_update = sparse_update
         # post-update hooks, e.g. HookAttribute/StaticPruningHook parity
         # (reference: parameter/ParameterUpdaterHook.cpp) — objects with
-        # init_mask(param) and apply(param) -> param
+        # init_mask(name, param) and apply(name, param) -> param
         self.update_hooks = update_hooks
 
     @staticmethod

@@ -133,7 +133,7 @@ class _StepProgram:
             for item in inputs:
                 if isinstance(item, StaticInput):
                     ph = _step_input(item.size, "static%d" % len(self.static_inputs))
-                    self.static_inputs.append((item.input, ph))
+                    self.static_inputs.append((item.input, ph, item.is_seq))
                     placeholders.append(ph)
                 elif isinstance(item, GeneratedInput):
                     enforce(self.generated is None,
@@ -189,6 +189,15 @@ class _StepProgram:
         for node in self.step_order:
             self.param_specs.extend(node.param_specs)
 
+    def static_leaf_values(self, outer_values):
+        """{id(placeholder): value} for static inputs; is_seq statics stay
+        SequenceBatch so attention over the encoder masks padding."""
+        leaf = {}
+        for outer, ph, stat_seq in self.static_inputs:
+            v = outer_values[id(outer)]
+            leaf[id(ph)] = v if (stat_seq and is_seq(v)) else data_of(v)
+        return leaf
+
     def eval_step(self, params, leaf_values, ctx):
         """Evaluate the step subgraph given leaf values {id(node): value}."""
         values = dict(leaf_values)
@@ -225,7 +234,7 @@ def recurrent_group(step, input, reverse=False, name=None, targetInlink=None):
             "recurrent_group needs at least one sequence input")
 
     outer_inputs = [outer for outer, _ in program.seq_inputs] + \
-        [outer for outer, _ in program.static_inputs] + \
+        [outer for outer, _, _ in program.static_inputs] + \
         [m.boot_layer for m in program.memories if m.boot_layer is not None] + \
         program.outer_captures
     # de-dup outer inputs, keep order
@@ -249,10 +258,7 @@ def recurrent_group(step, input, reverse=False, name=None, targetInlink=None):
         mask = ref.mask(dtype)
 
         outer_values = {id(n): values[slot_of[id(n)]] for n in graph_inputs}
-        static_leaf = {
-            id(ph): data_of(outer_values[id(outer)])
-            for outer, ph in program.static_inputs
-        }
+        static_leaf = program.static_leaf_values(outer_values)
         boots = program.boot_values(params, outer_values, batch, dtype)
 
         datas = [sv.reverse().data if reverse else sv.data for sv in seq_vals]
@@ -289,6 +295,10 @@ def recurrent_group(step, input, reverse=False, name=None, targetInlink=None):
     node = make_node("recurrent_group", forward, graph_inputs, name=name,
                      size=out_node_inner.size,
                      param_specs=program.param_specs)
+    # propagate the inner output's activation marker so cost layers treat
+    # softmax-activated step outputs as probabilities, not logits
+    node.output_activation = getattr(out_node_inner, "output_activation",
+                                     None)
     node._step_program = program
     return node
 
@@ -370,7 +380,7 @@ class BeamSearchGenerator:
         self.max_length = max_length
         self.num_results = num_results
         # outer context nodes (encoder outputs etc.)
-        self.outer_nodes = [outer for outer, _ in program.static_inputs] + \
+        self.outer_nodes = [outer for outer, _, _ in program.static_inputs] + \
             [m.boot_layer for m in program.memories
              if m.boot_layer is not None] + program.outer_captures
         seen = set()
@@ -407,15 +417,15 @@ class BeamSearchGenerator:
             batch = 1
 
         emb_table = params[gen.embedding_name]
-        static_leaf_base = {
-            id(ph): data_of(outer_values[id(outer)])
-            for outer, ph in program.static_inputs
-        }
+        static_leaf_base = program.static_leaf_values(outer_values)
         boots = program.boot_values(params, outer_values, batch,
                                     emb_table.dtype)
 
         # expand batch -> batch*beam
         def tile(x):
+            if is_seq(x):
+                return SequenceBatch(jnp.repeat(x.data, beam, axis=0),
+                                     jnp.repeat(x.lengths, beam, axis=0))
             return jnp.repeat(x, beam, axis=0)
 
         static_leaf = {k: tile(v) for k, v in static_leaf_base.items()}
@@ -456,7 +466,14 @@ class BeamSearchGenerator:
                 new_tokens == self.eos_id)
             new_history = jnp.take(history, flat_parent, axis=0)
             new_history = new_history.at[:, t].set(new_tokens)
-            new_mems = [jnp.take(m, flat_parent, axis=0) for m in mems]
+            # advance each memory to its step-updated value, then reorder
+            # by the surviving beam's parent (frozen memories would reduce
+            # the decoder to a bigram model)
+            new_mems = []
+            for m, old in zip(program.memories, mems):
+                stepped = data_of(vals[id(program.by_name[m.memory_of])])
+                stepped = jnp.where(finished[:, None], old, stepped)
+                new_mems.append(jnp.take(stepped, flat_parent, axis=0))
             return (new_tokens, new_scores, new_finished, new_history,
                     new_mems), None
 

@@ -3,5 +3,6 @@
 googlenet/smallnet, benchmark/paddle/rnn IMDB LSTM, model_zoo resnet,
 quick_start text models, sequence_tagging BiLSTM-CRF, seq2seq NMT)."""
 
-from paddle_tpu.models import vision
+from paddle_tpu.models import recommender
 from paddle_tpu.models import text
+from paddle_tpu.models import vision

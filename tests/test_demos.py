@@ -72,3 +72,8 @@ def test_model_zoo_resnet():
                    ["--depth", "18", "--im-size", "32", "--batch", "2",
                     "--classes", "10"])
     assert "top-1 classes:" in out and "features from" in out
+
+
+def test_seq2seq_demo():
+    out = run_demo("seq2seq", "train.py", ["--quick"])
+    assert "beam best" in out

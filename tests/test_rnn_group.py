@@ -193,3 +193,46 @@ def test_beam_search_runs_and_is_sorted():
         params1._values[name] = params._values[name]
     seqs1, lengths1, scores1 = gen1.generate(params1)
     assert scores[0, 0] >= scores1[0, 0] - 1e-5  # beam>=greedy
+
+
+def test_beam_search_memory_advances_between_steps():
+    """Regression: generation must feed each step the UPDATED memory (a
+    frozen memory turns any decoder into a bigram model). Hand-set
+    parameters make the memory a step counter whose position selects the
+    output token: correct decode = [0, 1, 2]."""
+    from paddle_tpu.graph import ParamSpec
+    from paddle_tpu.initializer import Constant
+    from paddle_tpu.parameters import Parameters
+
+    vocab = 5
+
+    def step(prev_emb):  # ignores the fed-back embedding on purpose
+        mem = L.memory(name="cnt_h", size=4)
+        h = L.fc(input=mem, size=4, act=None, name="cnt_h")
+        return L.fc(input=h, size=vocab, act=A.Softmax(), name="cnt_out",
+                    bias_attr=False)
+
+    gen = L.beam_search(
+        step=step,
+        input=[L.GeneratedInput(size=vocab, embedding_name="cnt_emb",
+                                embedding_size=2, bos_id=0, eos_id=4)],
+        bos_id=0, eos_id=4, beam_size=1, max_length=3)
+
+    W = np.zeros((4, 4), np.float32)  # shift: h_t = h_{t-1} @ W + e0
+    for i in range(3):
+        W[i, i + 1] = 1.0
+    bias = np.zeros((4,), np.float32)
+    bias[0] = 1.0
+    V = np.zeros((4, vocab), np.float32)
+    for i in range(4):
+        V[i, i] = 10.0 * (i + 1)  # newest counter position wins
+
+    params = Parameters()
+    hand = {"cnt_h.w0": W, "cnt_h.wbias": bias, "cnt_out.w0": V,
+            "cnt_emb": np.zeros((vocab, 2), np.float32)}
+    for name, val in hand.items():
+        params._specs[name] = ParamSpec(name, val.shape, Constant(0.0))
+        params._values[name] = val
+
+    seqs, lengths, scores = gen.generate(params)
+    assert seqs[0, 0].tolist() == [0, 1, 2]
