@@ -102,6 +102,13 @@ def init(use_tpu=None, trainer_count=1, seed=None, log_level=None, **kwargs):
         from paddle_tpu.utils import logger as _logger
 
         _logger.set_level(log_level)
+    # FPE-trap parity (reference: feenableexcept(FE_INVALID|FE_DIVBYZERO|
+    # FE_OVERFLOW) at trainer start, TrainerMain.cpp:49): fail fast on
+    # NaN/Inf from jitted programs instead of training through garbage.
+    # Set unconditionally so re-init with trap_fpe=False turns it back off.
+    _trap = bool(_flags.get_flag("trap_fpe"))
+    jax.config.update("jax_debug_nans", _trap)
+    jax.config.update("jax_debug_infs", _trap)
     set_default_place(TPUPlace() if use_tpu else CPUPlace())
     _initialized = True
     return None

@@ -83,29 +83,37 @@ class Topology:
         layers' values are returned (all output nodes by default).
         """
         ctx = Context(mode=mode, rng=rng)
-        values = {}
-        for node in self.nodes:
-            if node.layer_type == "data":
-                enforce(node.name in feed, "missing feed for data layer %r", node.name)
-                values[node.name] = node.forward(params, [feed[node.name]], ctx)
-            else:
-                inputs = [values[p.name] for p in node.inputs]
-                values[node.name] = node.forward(params, inputs, ctx)
+        values = self._run_nodes(params, feed, ctx)
         wanted = outputs or [o.name for o in self.outputs]
         return {name: values[name] for name in wanted}, ctx.state_updates
+
+    def _run_nodes(self, params, feed, ctx):
+        values = {}
+        for node in self.nodes:
+            try:
+                if node.layer_type == "data":
+                    enforce(node.name in feed,
+                            "missing feed for data layer %r", node.name)
+                    values[node.name] = node.forward(params,
+                                                     [feed[node.name]], ctx)
+                else:
+                    inputs = [values[p.name] for p in node.inputs]
+                    values[node.name] = node.forward(params, inputs, ctx)
+            except Exception as exc:
+                # layer-stack context on failure (reference: CustomStackTrace
+                # gLayerStackTrace, NeuralNetwork.cpp:244-251 — crashes name
+                # the offending layer)
+                exc.add_note("  in layer %r (type %s), inputs: %s" % (
+                    node.name, node.layer_type,
+                    [p.name for p in node.inputs]))
+                raise
+        return values
 
     def apply_all(self, params, feed, mode="test", rng=None):
         """Like apply() but returns every layer's value (debug / tests /
         --show_layer_stat parity)."""
         ctx = Context(mode=mode, rng=rng)
-        values = {}
-        for node in self.nodes:
-            if node.layer_type == "data":
-                values[node.name] = node.forward(params, [feed[node.name]], ctx)
-            else:
-                inputs = [values[p.name] for p in node.inputs]
-                values[node.name] = node.forward(params, inputs, ctx)
-        return values, ctx.state_updates
+        return self._run_nodes(params, feed, ctx), ctx.state_updates
 
     def data_types(self):
         """[(name, InputType)] for feeder construction, in *declaration

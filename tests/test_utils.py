@@ -111,3 +111,41 @@ def test_convert_feed_declaration_order():
     feed = convert_feed(topo, batch)
     np.testing.assert_array_equal(np.asarray(feed["aa_second"]), [1, 2])
     np.testing.assert_array_equal(np.asarray(feed["zz_first"]).shape, (2, 2))
+
+
+def test_layer_error_context_names_offending_layer():
+    """CustomStackTrace parity: a failing layer is named in the exception."""
+    import numpy as np
+    import pytest
+
+    from paddle_tpu import layer as L, data_type as dt
+    from paddle_tpu.topology import Topology
+
+    x = L.data(name="ec_x", type=dt.dense_vector(4))
+    h = L.fc(input=x, size=4, name="ec_fc")
+
+    def boom(params, values, ctx):
+        raise ValueError("kernel exploded")
+
+    from paddle_tpu.layer.base import make_node
+
+    bad = make_node("custom", boom, [h], name="ec_bad", size=4)
+    topo = Topology(bad)
+    import jax
+
+    params = topo.init_params(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError) as ei:
+        topo.apply(params, {"ec_x": np.zeros((2, 4), np.float32)})
+    notes = "".join(getattr(ei.value, "__notes__", []))
+    assert "ec_bad" in notes
+
+
+def test_trap_fpe_flag_roundtrip():
+    from paddle_tpu.utils import flags as fl
+
+    original = fl.get_flag("trap_fpe")
+    try:
+        fl.set_flag("trap_fpe", True)
+        assert fl.get_flag("trap_fpe") is True
+    finally:
+        fl.set_flag("trap_fpe", original)
