@@ -1,0 +1,7 @@
+"""Native data plane: record files + background prefetch (see recordio.py)."""
+
+from paddle_tpu.io.recordio import (PrefetchPool, RecordReader, RecordWriter,
+                                    pool_reader, read_records, write_records)
+
+__all__ = ["RecordWriter", "RecordReader", "PrefetchPool", "write_records",
+           "read_records", "pool_reader"]

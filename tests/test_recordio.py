@@ -1,0 +1,105 @@
+"""Native record-IO tests (reference pattern: recordio chunk files the Go
+master partitions, go/master/service.go:105; PyDataProvider2 pool thread,
+PyDataProvider2.cpp:334)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import recordio
+
+
+def test_native_library_builds():
+    assert recordio.native_available(), "librecordio.so must build"
+
+
+def test_write_read_roundtrip(tmp_path):
+    path = str(tmp_path / "shard0.rec")
+    samples = [(np.arange(4).tolist(), i) for i in range(50)]
+    n = recordio.write_records(path, samples)
+    assert n == 50
+    back = list(recordio.read_records(path))
+    assert back == samples
+
+
+def test_corruption_detected(tmp_path):
+    path = str(tmp_path / "bad.rec")
+    recordio.write_records(path, [b"x" * 100])
+    data = bytearray(open(path, "rb").read())
+    data[-5] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(IOError, match="crc|corrupt"):
+        list(recordio.read_records(path))
+
+
+def test_bad_magic_rejected(tmp_path):
+    path = str(tmp_path / "not.rec")
+    open(path, "wb").write(b"NOTMAGIC" + b"\0" * 16)
+    with pytest.raises(IOError):
+        recordio.RecordReader(path)
+
+
+def test_prefetch_pool_reads_all_shards(tmp_path):
+    paths = []
+    expected = set()
+    for shard in range(5):
+        p = str(tmp_path / ("shard%d.rec" % shard))
+        samples = [(shard, i) for i in range(40)]
+        recordio.write_records(p, samples)
+        expected.update(samples)
+        paths.append(p)
+    got = [s for s in recordio.pool_reader(paths, n_threads=3,
+                                           capacity=16)()]
+    assert len(got) == 200
+    assert set(got) == expected
+
+
+def test_pool_reader_composes_with_decorators(tmp_path):
+    from paddle_tpu.reader import decorator as dec
+
+    p = str(tmp_path / "s.rec")
+    recordio.write_records(p, [(i, i * 2) for i in range(30)])
+    r = dec.shuffle(recordio.pool_reader([p]), buf_size=10, seed=1)
+    out = list(r())
+    assert len(out) == 30 and set(out) == {(i, i * 2) for i in range(30)}
+
+
+def test_pool_error_surfaces(tmp_path):
+    good = str(tmp_path / "good.rec")
+    recordio.write_records(good, [1, 2, 3])
+    bad = str(tmp_path / "missing.rec")
+    with pytest.raises(IOError):
+        list(recordio.pool_reader([good, bad], n_threads=1)())
+
+
+def test_shard_dataset_and_coordinator_flow(tmp_path):
+    """Full data-plane flow: shard a reader, register shards as coordinator
+    dataset, pull tasks, read each task's chunks (go/master role parity)."""
+    from paddle_tpu.distributed import client as cclient
+
+    def reader():
+        for i in range(40):
+            yield (i, i * i)
+
+    paths = recordio.shard_dataset(reader, str(tmp_path / "ds"),
+                                   num_shards=4)
+    assert len(paths) == 4
+
+    port, proc = cclient.spawn_coordinator_on_free_port()
+    try:
+        c = cclient.CoordinatorClient("127.0.0.1:%d" % port,
+                                      worker_id="w0")
+        c.set_dataset(paths, chunks_per_task=2)
+        seen = []
+        for _ in range(2):
+            task_id, chunks = c.get_task()
+            for ch in chunks:
+                seen.extend(recordio.read_records(ch))
+            c.task_finished(task_id)
+        assert sorted(s[0] for s in seen) == list(range(40))
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait()
