@@ -37,19 +37,22 @@ namespace {
 
 constexpr char kMagic[8] = {'P', 'T', 'R', 'E', 'C', 'I', 'O', '1'};
 
-uint32_t crc32(const uint8_t* data, size_t n) {
-  static uint32_t table[256];
-  static bool init = false;
-  if (!init) {
+struct CrcTable {
+  uint32_t t[256];
+  CrcTable() {
     for (uint32_t i = 0; i < 256; i++) {
       uint32_t c = i;
       for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-      table[i] = c;
+      t[i] = c;
     }
-    init = true;
   }
+};
+
+uint32_t crc32(const uint8_t* data, size_t n) {
+  // magic static: C++11 guarantees thread-safe one-time construction
+  static const CrcTable table;
   uint32_t c = 0xFFFFFFFFu;
-  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  for (size_t i = 0; i < n; i++) c = table.t[(c ^ data[i]) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
 }
 
@@ -174,6 +177,7 @@ void* recordio_writer_open(const char* path) {
 
 int recordio_writer_write(void* w, const uint8_t* data, uint32_t len) {
   Writer* wr = (Writer*)w;
+  if (len > (1u << 30)) return -1;  // reader enforces the same cap
   uint32_t crc = crc32(data, len);
   if (fwrite(&len, 1, 4, wr->f) != 4) return -1;
   if (fwrite(&crc, 1, 4, wr->f) != 4) return -1;

@@ -22,22 +22,24 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "librecordio.so")
 _MAGIC = b"PTRECIO1"
 
-_lib = None
+_lib = None  # None = not attempted, False = unavailable (cached failure)
 
 
 def _load():
     global _lib
     if _lib is not None:
-        return _lib
+        return _lib or None
     if not os.path.exists(_SO):
         try:
             subprocess.run(["make", "-C", _DIR], check=True,
                            capture_output=True)
         except Exception:
+            _lib = False
             return None
     try:
         lib = ctypes.CDLL(_SO)
     except OSError:
+        _lib = False
         return None
     lib.recordio_writer_open.restype = ctypes.c_void_p
     lib.recordio_writer_open.argtypes = [ctypes.c_char_p]

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
 from paddle_tpu.graph import Context, LayerNode, auto_name, topo_sort
 from paddle_tpu.layer.base import data_of, is_seq, make_node, register_layer, to_list
 from paddle_tpu.utils.error import enforce
@@ -56,22 +56,28 @@ class GeneratedInput:
 
 
 def _begin_group(group_id):
-    _group_state.current = {
+    """Push a group trace frame; a stack so recurrent_group can nest
+    (reference: nested RecurrentLayerGroups for sub-sequence RNNs,
+    config_parser RecurrentLayerGroupBegin :366)."""
+    stack = getattr(_group_state, "stack", None)
+    if stack is None:
+        stack = _group_state.stack = []
+    state = {
         "id": group_id,
         "memories": [],  # memory placeholder nodes
         "nodes": [],     # nodes created during the step trace
     }
-    return _group_state.current
-
-
-def _end_group():
-    state = getattr(_group_state, "current", None)
-    _group_state.current = None
+    stack.append(state)
     return state
 
 
+def _end_group():
+    return _group_state.stack.pop()
+
+
 def _current_group():
-    return getattr(_group_state, "current", None)
+    stack = getattr(_group_state, "stack", None)
+    return stack[-1] if stack else None
 
 
 # patch LayerNode creation to tag nodes built inside a step trace
@@ -220,6 +226,74 @@ class _StepProgram:
         return boots
 
 
+def _nested_forward(program, slot_of, graph_inputs, out_node_inner, reverse,
+                    params, values, ctx, seq_vals):
+    """Outer-axis scan for nested (two-level) sequence inputs: each outer
+    step sees one SUB-SEQUENCE as a SequenceBatch, so the step function can
+    run sequence ops — or a nested recurrent_group — over it (reference:
+    sub-sequence RNN groups, test_RecurrentGradientMachine
+    sequence_nest_rnn.conf equivalences)."""
+    enforce(not reverse,
+            "reverse=True over nested sequences is not supported yet; "
+            "reverse the outer order in the reader")
+    ref = next(sv for sv in seq_vals
+               if isinstance(sv, NestedSequenceBatch))
+    batch = ref.batch_size
+    outer_mask_sm = jnp.swapaxes(ref.outer_mask(), 0, 1)  # [S, B]
+
+    outer_values = {id(n): values[slot_of[id(n)]] for n in graph_inputs}
+    static_leaf = program.static_leaf_values(outer_values)
+    boots = program.boot_values(params, outer_values, batch, ref.data.dtype)
+
+    xs = []
+    kinds = []  # "nested" | "flat"
+    for sv in seq_vals:
+        if isinstance(sv, NestedSequenceBatch):
+            enforce(sv.max_subseqs == ref.max_subseqs,
+                    "nested inputs must agree on sub-sequence count")
+            xs.append((jnp.swapaxes(sv.data, 0, 1),          # [S, B, T, ...]
+                       jnp.swapaxes(sv.inner_lengths, 0, 1)))  # [S, B]
+            kinds.append("nested")
+        else:
+            enforce(is_seq(sv), "recurrent_group inputs must be sequences")
+            # flat inlinks iterate one element per sub-sequence; compare
+            # real lengths, not bucket-padded dims, then align padding
+            enforce(sv.max_len >= ref.max_subseqs,
+                    "flat sequence input shorter than sub-sequence count")
+            xs.append((jnp.swapaxes(sv.data[:, :ref.max_subseqs], 0, 1),))
+            kinds.append("flat")
+
+    def body(carry, scanned):
+        mems = carry
+        step_mask, step_xs = scanned
+        leaf = dict(static_leaf)
+        for (outer, ph), kind, x in zip(program.seq_inputs, kinds, step_xs):
+            if kind == "nested":
+                leaf[id(ph)] = SequenceBatch(x[0], x[1])
+            else:
+                leaf[id(ph)] = x[0]
+        for m, mv in zip(program.memories, mems):
+            leaf[id(m)] = mv
+        vals = program.eval_step(params, leaf, ctx)
+        new_mems = []
+        for m, old in zip(program.memories, mems):
+            new = data_of(vals[id(program.by_name[m.memory_of])])
+            keep = step_mask[:, None].astype(new.dtype)
+            new_mems.append(new * keep + old * (1.0 - keep))
+        return tuple(new_mems), vals[id(out_node_inner)]
+
+    _, ys = lax.scan(body, tuple(boots),
+                     (outer_mask_sm, tuple(xs)))
+    if isinstance(ys, SequenceBatch):
+        # step emitted a full inner sequence -> nested output [B, S, T, ...]
+        data = jnp.swapaxes(ys.data, 0, 1)
+        inner = jnp.swapaxes(ys.lengths, 0, 1)
+        return NestedSequenceBatch(data, ref.outer_lengths, inner)
+    out = jnp.swapaxes(ys, 0, 1)  # [B, S, ...]
+    out = out * ref.outer_mask(out.dtype)[..., None]
+    return SequenceBatch(out, ref.outer_lengths)
+
+
 @register_layer("recurrent_group")
 def recurrent_group(step, input, reverse=False, name=None, targetInlink=None):
     """Run ``step`` over the timesteps of the sequence inputs (reference:
@@ -250,6 +324,10 @@ def recurrent_group(step, input, reverse=False, name=None, targetInlink=None):
 
     def forward(params, values, ctx):
         seq_vals = [values[slot_of[id(outer)]] for outer, _ in program.seq_inputs]
+        if any(isinstance(sv, NestedSequenceBatch) for sv in seq_vals):
+            return _nested_forward(program, slot_of, graph_inputs,
+                                   out_node_inner, reverse, params, values,
+                                   ctx, seq_vals)
         for sv in seq_vals:
             enforce(is_seq(sv), "recurrent_group inputs must be sequences")
         ref = seq_vals[0]
@@ -283,7 +361,6 @@ def recurrent_group(step, input, reverse=False, name=None, targetInlink=None):
             out_t = data_of(vals[id(out_node_inner)])
             return tuple(new_mems), out_t
 
-        ctx_inner = Context(mode=ctx.mode, rng=ctx.rng)
         _, ys = lax.scan(body, tuple(boots), (*xs_tm, mask_tm))
         out_seq = jnp.swapaxes(ys, 0, 1)
         result = SequenceBatch(out_seq, ref.lengths)

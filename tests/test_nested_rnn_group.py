@@ -1,0 +1,181 @@
+"""Nested (two-level) recurrent-group tests — the reference's sub-sequence
+RNN groups (pattern: test_RecurrentGradientMachine.cpp comparing
+sequence_nest_rnn.conf vs sequence_rnn.conf: the nested formulation must
+equal the flat computation done per sub-sequence)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import activation as A
+from paddle_tpu import data_type as dt
+from paddle_tpu import layer as L
+from paddle_tpu.attr import ParamAttr
+from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
+from paddle_tpu.graph import reset_name_counters
+from paddle_tpu.topology import Topology
+
+DIM = 3
+
+
+def _nested_batch(seed=0):
+    rng = np.random.RandomState(seed)
+    nested = [
+        [rng.randn(2, DIM).astype(np.float32),
+         rng.randn(4, DIM).astype(np.float32),
+         rng.randn(3, DIM).astype(np.float32)],
+        [rng.randn(5, DIM).astype(np.float32)],
+    ]
+    return nested, NestedSequenceBatch.from_nested(nested)
+
+
+def test_outer_group_last_seq_of_subsequences():
+    """Outer group + last_seq per sub-sequence == manual last elements."""
+    reset_name_counters()
+    nested, nb = _nested_batch()
+    x = L.data(name="nx", type=dt.dense_vector_sub_sequence(DIM))
+
+    def step(sub):
+        return L.last_seq(input=sub, name="nst_last")
+
+    out = L.recurrent_group(step=step, input=x, name="nst_outer")
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    vals, _ = topo.apply(params, {"nx": nb}, mode="test")
+    got = vals[out.name]
+    assert isinstance(got, SequenceBatch)
+    arr = np.asarray(got.data)
+    np.testing.assert_array_equal(np.asarray(got.lengths), [3, 1])
+    for i, subs in enumerate(nested):
+        for j, sub in enumerate(subs):
+            np.testing.assert_allclose(arr[i, j], sub[-1], rtol=1e-6)
+    # padded outer slots are zero
+    np.testing.assert_array_equal(arr[1, 1:], 0.0)
+
+
+def test_outer_group_memory_accumulates_over_subsequences():
+    """Memory carries across sub-sequences: running sum of per-subsequence
+    sums equals a manual prefix sum over the outer axis."""
+    reset_name_counters()
+    nested, nb = _nested_batch(seed=1)
+    x = L.data(name="mx", type=dt.dense_vector_sub_sequence(DIM))
+
+    from paddle_tpu import pooling as pool
+
+    def step(sub):
+        mem = L.memory(name="acc_out", size=DIM)
+        s = L.pooling(input=sub, pooling_type=pool.SumPooling(),
+                      name="acc_sub")
+        return L.addto(input=[s, mem], name="acc_out")
+
+    out = L.recurrent_group(step=step, input=x, name="acc_outer")
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    vals, _ = topo.apply(params, {"mx": nb}, mode="test")
+    arr = np.asarray(vals[out.name].data)
+    for i, subs in enumerate(nested):
+        run = np.zeros(DIM, np.float32)
+        for j, sub in enumerate(subs):
+            run = run + sub.sum(axis=0)
+            np.testing.assert_allclose(arr[i, j], run, rtol=1e-5)
+
+
+def test_nested_group_in_group_matches_flat_inner_group():
+    """A recurrent_group nested inside an outer group over sub-sequences
+    must equal running the same inner group on each sub-sequence flat
+    (the test_RecurrentGradientMachine equivalence)."""
+    rng = np.random.RandomState(3)
+
+    def inner_step_factory():
+        def inner_step(x_t):
+            mem = L.memory(name="nin_h", size=DIM)
+            return L.fc(input=[x_t, mem], size=DIM, act=A.Tanh(),
+                        name="nin_h",
+                        param_attr=ParamAttr(name="nin_w"),
+                        bias_attr=False)
+
+        return inner_step
+
+    # nested formulation
+    reset_name_counters()
+    nested, nb = _nested_batch(seed=2)
+    x = L.data(name="gx", type=dt.dense_vector_sub_sequence(DIM))
+
+    def outer_step(sub):
+        inner = L.recurrent_group(step=inner_step_factory(), input=sub,
+                                  name="nin_group")
+        return L.last_seq(input=inner, name="nin_last")
+
+    out = L.recurrent_group(step=outer_step, input=x, name="nout_group")
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(7))
+    vals, _ = topo.apply(params, {"gx": nb}, mode="test")
+    nested_out = np.asarray(vals[out.name].data)
+
+    # flat formulation: same inner group applied to each sub-sequence
+    reset_name_counters()
+    fx = L.data(name="fx", type=dt.dense_vector_sequence(DIM))
+    flat_inner = L.recurrent_group(step=inner_step_factory(), input=fx,
+                                   name="fin_group")
+    flat_last = L.last_seq(input=flat_inner, name="fin_last")
+    ftopo = Topology(flat_last)
+    fparams = {"nin_w": params["nin_w"]}
+    for i, subs in enumerate(nested):
+        for j, sub in enumerate(subs):
+            fvals, _ = ftopo.apply(fparams,
+                                   {"fx": SequenceBatch.from_sequences([sub])},
+                                   mode="test")
+            np.testing.assert_allclose(nested_out[i, j],
+                                       np.asarray(fvals[flat_last.name])[0],
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_nested_group_gradients_flow():
+    reset_name_counters()
+    nested, nb = _nested_batch(seed=4)
+    x = L.data(name="ggx", type=dt.dense_vector_sub_sequence(DIM))
+
+    def outer_step(sub):
+        h = L.fc(input=sub, size=DIM, act=A.Tanh(), name="gg_fc",
+                 param_attr=ParamAttr(name="gg_w"), bias_attr=False)
+        return L.last_seq(input=h, name="gg_last")
+
+    out = L.recurrent_group(step=outer_step, input=x, name="gg_outer")
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+
+    def loss(p):
+        vals, _ = topo.apply(p, {"ggx": nb}, mode="test")
+        return jnp.sum(vals[out.name].data ** 2)
+
+    g = jax.grad(loss)(params)
+    assert float(jnp.abs(g["gg_w"]).max()) > 0
+
+
+def test_mixed_flat_and_nested_inlinks():
+    """A flat per-subsequence inlink (bucket-padded) alongside the nested
+    inlink (the reference's mixed-inlink sequence_nest_rnn pattern)."""
+    reset_name_counters()
+    nested, nb = _nested_batch(seed=5)
+    # one flat element per sub-sequence; from_sequences bucket-pads max_len
+    flat = SequenceBatch.from_sequences(
+        [np.ones((3, DIM), np.float32), 2 * np.ones((1, DIM), np.float32)])
+    assert flat.max_len > nb.max_subseqs  # the bucket-padding the fix covers
+    x = L.data(name="mixn", type=dt.dense_vector_sub_sequence(DIM))
+    f = L.data(name="mixf", type=dt.dense_vector_sequence(DIM))
+
+    def step(sub, f_t):
+        s = L.last_seq(input=sub, name="mix_last")
+        return L.addto(input=[s, f_t], name="mix_out")
+
+    out = L.recurrent_group(step=step, input=[x, f], name="mix_outer")
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    vals, _ = topo.apply(params, {"mixn": nb, "mixf": flat}, mode="test")
+    arr = np.asarray(vals[out.name].data)
+    for i, subs in enumerate(nested):
+        add = 1.0 if i == 0 else 2.0
+        for j, sub in enumerate(subs):
+            np.testing.assert_allclose(arr[i, j], sub[-1] + add, rtol=1e-6)
