@@ -25,12 +25,35 @@ import sys
 import time
 
 
-def _load_config(path):
+def _load_config(path, config_args=""):
+    from paddle_tpu import config as cfgmod
+
+    cfgmod.reset()
+    cfgmod.set_config_args(config_args)
     spec = importlib.util.spec_from_file_location("paddle_tpu_user_config",
                                                   path)
     mod = importlib.util.module_from_spec(spec)
     sys.modules["paddle_tpu_user_config"] = mod
     spec.loader.exec_module(mod)
+    # v1-DSL configs (settings()/outputs()/define_py_data_sources2) leave
+    # their declarations in the config registry; adapt them onto the
+    # cost()/optimizer()/train_reader() surface the commands consume
+    st = cfgmod.pop_config()
+    if st is not None:
+        outputs = st["outputs"]
+        if outputs and not hasattr(mod, "cost"):
+            mod.cost = (lambda: outputs[0] if len(outputs) == 1
+                        else outputs)
+        if not hasattr(mod, "optimizer") and st["settings"].get("optimizer"):
+            mod.optimizer = lambda: st["settings"]["optimizer"]
+        if st["settings"].get("batch_size") and not hasattr(mod,
+                                                            "batch_size"):
+            mod.batch_size = st["settings"]["batch_size"]
+        ds = st["data_sources"]
+        if "train" in ds and not hasattr(mod, "train_reader"):
+            mod.train_reader = ds["train"]
+        if "test" in ds and not hasattr(mod, "test_reader"):
+            mod.test_reader = ds["test"]
     return mod
 
 
@@ -55,7 +78,8 @@ def cmd_train(args):
     import paddle_tpu as paddle
     from paddle_tpu import minibatch
 
-    cfg = _load_config(args.config)
+    cfg = _load_config(args.config,
+                       getattr(args, "config_args", ""))
     cost, params, trainer = _build(cfg)
     batch_size = getattr(cfg, "batch_size", args.batch_size)
     reader = minibatch.batch(cfg.train_reader(), batch_size)
@@ -81,7 +105,8 @@ def cmd_test(args):
     import paddle_tpu as paddle
     from paddle_tpu import minibatch
 
-    cfg = _load_config(args.config)
+    cfg = _load_config(args.config,
+                       getattr(args, "config_args", ""))
     cost, params, trainer = _build(cfg)
     if args.params:
         with open(args.params, "rb") as f:
@@ -100,7 +125,8 @@ def cmd_time(args):
 
     from paddle_tpu import minibatch
 
-    cfg = _load_config(args.config)
+    cfg = _load_config(args.config,
+                       getattr(args, "config_args", ""))
     cost, params, trainer = _build(cfg)
     batch_size = getattr(cfg, "batch_size", args.batch_size)
     batches = list(minibatch.batch(cfg.train_reader(), batch_size)())
@@ -129,7 +155,8 @@ def cmd_checkgrad(args):
     from paddle_tpu import minibatch
     from paddle_tpu.topology import Topology, convert_feed
 
-    cfg = _load_config(args.config)
+    cfg = _load_config(args.config,
+                       getattr(args, "config_args", ""))
     cost = cfg.cost()
     topo = Topology(cost)
     batch = next(iter(minibatch.batch(cfg.train_reader(),
@@ -172,6 +199,9 @@ def main(argv=None):
 
     common = argparse.ArgumentParser(add_help=False)
     common.add_argument("--config", required=True)
+    common.add_argument("--config-args", default="",
+                        help="k=v,... template parameters readable via "
+                             "paddle_tpu.config.get_config_arg")
     common.add_argument("--batch-size", type=int, default=64)
     common.add_argument("--use-tpu", action="store_true", default=None)
 

@@ -95,6 +95,17 @@ class Context:
         )
         return jax.random.fold_in(self.rng, next(self._rng_counter))
 
+    def group_rng(self, key):
+        """Stable per-group RNG base: a recurrent_group and its get_output
+        siblings re-run the same scan and must draw IDENTICAL streams (so
+        XLA CSE merges them and stochastic layers stay consistent)."""
+        cache = getattr(self, "_group_rng", None)
+        if cache is None:
+            cache = self._group_rng = {}
+        if key not in cache:
+            cache[key] = None if self.rng is None else self.next_rng()
+        return cache[key]
+
     def update_state(self, name, value):
         self.state_updates[name] = value
 

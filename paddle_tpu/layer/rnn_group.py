@@ -226,7 +226,7 @@ class _StepProgram:
         return boots
 
 
-def _nested_forward(program, slot_of, graph_inputs, out_node_inner, reverse,
+def _nested_forward(program, slot_of, graph_inputs, out_idx, reverse,
                     params, values, ctx, seq_vals):
     """Outer-axis scan for nested (two-level) sequence inputs: each outer
     step sees one SUB-SEQUENCE as a SequenceBatch, so the step function can
@@ -244,6 +244,7 @@ def _nested_forward(program, slot_of, graph_inputs, out_node_inner, reverse,
     outer_values = {id(n): values[slot_of[id(n)]] for n in graph_inputs}
     static_leaf = program.static_leaf_values(outer_values)
     boots = program.boot_values(params, outer_values, batch, ref.data.dtype)
+    sub_ctx = Context(mode=ctx.mode, rng=ctx.group_rng(id(program)))
 
     xs = []
     kinds = []  # "nested" | "flat"
@@ -256,10 +257,19 @@ def _nested_forward(program, slot_of, graph_inputs, out_node_inner, reverse,
             kinds.append("nested")
         else:
             enforce(is_seq(sv), "recurrent_group inputs must be sequences")
-            # flat inlinks iterate one element per sub-sequence; compare
-            # real lengths, not bucket-padded dims, then align padding
+            # flat inlinks iterate one element per sub-sequence; when the
+            # lengths are concrete (not traced), verify per row that the
+            # flat inlink covers every real sub-sequence
             enforce(sv.max_len >= ref.max_subseqs,
                     "flat sequence input shorter than sub-sequence count")
+            try:
+                fl = np.asarray(sv.lengths)
+                ol = np.asarray(ref.outer_lengths)
+                enforce((fl >= ol).all(),
+                        "flat inlink lengths %s shorter than sub-sequence "
+                        "counts %s", fl.tolist(), ol.tolist())
+            except jax.errors.TracerArrayConversionError:
+                pass  # under jit: shapes already checked above
             xs.append((jnp.swapaxes(sv.data[:, :ref.max_subseqs], 0, 1),))
             kinds.append("flat")
 
@@ -274,16 +284,18 @@ def _nested_forward(program, slot_of, graph_inputs, out_node_inner, reverse,
                 leaf[id(ph)] = x[0]
         for m, mv in zip(program.memories, mems):
             leaf[id(m)] = mv
-        vals = program.eval_step(params, leaf, ctx)
+        vals = program.eval_step(params, leaf, sub_ctx)
         new_mems = []
         for m, old in zip(program.memories, mems):
             new = data_of(vals[id(program.by_name[m.memory_of])])
             keep = step_mask[:, None].astype(new.dtype)
             new_mems.append(new * keep + old * (1.0 - keep))
-        return tuple(new_mems), vals[id(out_node_inner)]
+        return tuple(new_mems), tuple(vals[id(o)]
+                                      for o in program.outputs)
 
-    _, ys = lax.scan(body, tuple(boots),
-                     (outer_mask_sm, tuple(xs)))
+    _, ys_all = lax.scan(body, tuple(boots),
+                         (outer_mask_sm, tuple(xs)))
+    ys = ys_all[out_idx]
     if isinstance(ys, SequenceBatch):
         # step emitted a full inner sequence -> nested output [B, S, T, ...]
         data = jnp.swapaxes(ys.data, 0, 1)
@@ -322,61 +334,75 @@ def recurrent_group(step, input, reverse=False, name=None, targetInlink=None):
 
     out_node_inner = program.outputs[0]
 
-    def forward(params, values, ctx):
-        seq_vals = [values[slot_of[id(outer)]] for outer, _ in program.seq_inputs]
-        if any(isinstance(sv, NestedSequenceBatch) for sv in seq_vals):
-            return _nested_forward(program, slot_of, graph_inputs,
-                                   out_node_inner, reverse, params, values,
-                                   ctx, seq_vals)
-        for sv in seq_vals:
-            enforce(is_seq(sv), "recurrent_group inputs must be sequences")
-        ref = seq_vals[0]
-        batch, t_max = ref.batch_size, ref.max_len
-        dtype = ref.data.dtype
-        mask = ref.mask(dtype)
+    def make_forward(out_idx):
+        """Forward returning the out_idx-th step output. Every variant
+        scans ALL outputs identically so XLA CSE merges the loops when a
+        get_output sibling re-runs the group."""
 
-        outer_values = {id(n): values[slot_of[id(n)]] for n in graph_inputs}
-        static_leaf = program.static_leaf_values(outer_values)
-        boots = program.boot_values(params, outer_values, batch, dtype)
+        def forward(params, values, ctx):
+            seq_vals = [values[slot_of[id(outer)]]
+                        for outer, _ in program.seq_inputs]
+            if any(isinstance(sv, NestedSequenceBatch) for sv in seq_vals):
+                return _nested_forward(program, slot_of, graph_inputs,
+                                       out_idx, reverse, params, values,
+                                       ctx, seq_vals)
+            for sv in seq_vals:
+                enforce(is_seq(sv),
+                        "recurrent_group inputs must be sequences")
+            ref = seq_vals[0]
+            batch = ref.batch_size
+            dtype = ref.data.dtype
 
-        datas = [sv.reverse().data if reverse else sv.data for sv in seq_vals]
-        xs_tm = [jnp.swapaxes(d, 0, 1) for d in datas]
-        mask_tm = jnp.swapaxes(ref.mask(), 0, 1)
+            outer_values = {id(n): values[slot_of[id(n)]]
+                            for n in graph_inputs}
+            static_leaf = program.static_leaf_values(outer_values)
+            boots = program.boot_values(params, outer_values, batch, dtype)
+            sub_ctx = Context(mode=ctx.mode, rng=ctx.group_rng(name))
 
-        def body(carry, xs):
-            mems = carry
-            step_mask = xs[-1]
-            step_xs = xs[:-1]
-            leaf = dict(static_leaf)
-            for (outer, ph), x_t in zip(program.seq_inputs, step_xs):
-                leaf[id(ph)] = x_t
-            for m, mv in zip(program.memories, mems):
-                leaf[id(m)] = mv
-            vals = program.eval_step(params, leaf, ctx)
-            new_mems = []
-            for m, old in zip(program.memories, mems):
-                new = data_of(vals[id(program.by_name[m.memory_of])])
-                keep = step_mask[:, None].astype(new.dtype)
-                new_mems.append(new * keep + old * (1.0 - keep))
-            out_t = data_of(vals[id(out_node_inner)])
-            return tuple(new_mems), out_t
+            datas = [sv.reverse().data if reverse else sv.data
+                     for sv in seq_vals]
+            xs_tm = [jnp.swapaxes(d, 0, 1) for d in datas]
+            mask_tm = jnp.swapaxes(ref.mask(), 0, 1)
 
-        _, ys = lax.scan(body, tuple(boots), (*xs_tm, mask_tm))
-        out_seq = jnp.swapaxes(ys, 0, 1)
-        result = SequenceBatch(out_seq, ref.lengths)
-        if reverse:
-            result = result.reverse()
-        return SequenceBatch(result.data * ref.mask(out_seq.dtype)[..., None],
-                             ref.lengths)
+            def body(carry, xs):
+                mems = carry
+                step_mask = xs[-1]
+                step_xs = xs[:-1]
+                leaf = dict(static_leaf)
+                for (outer, ph), x_t in zip(program.seq_inputs, step_xs):
+                    leaf[id(ph)] = x_t
+                for m, mv in zip(program.memories, mems):
+                    leaf[id(m)] = mv
+                vals = program.eval_step(params, leaf, sub_ctx)
+                new_mems = []
+                for m, old in zip(program.memories, mems):
+                    new = data_of(vals[id(program.by_name[m.memory_of])])
+                    keep = step_mask[:, None].astype(new.dtype)
+                    new_mems.append(new * keep + old * (1.0 - keep))
+                out_ts = tuple(data_of(vals[id(o)])
+                               for o in program.outputs)
+                return tuple(new_mems), out_ts
 
-    node = make_node("recurrent_group", forward, graph_inputs, name=name,
-                     size=out_node_inner.size,
+            _, ys = lax.scan(body, tuple(boots), (*xs_tm, mask_tm))
+            out_seq = jnp.swapaxes(ys[out_idx], 0, 1)
+            result = SequenceBatch(out_seq, ref.lengths)
+            if reverse:
+                result = result.reverse()
+            return SequenceBatch(
+                result.data * ref.mask(out_seq.dtype)[..., None],
+                ref.lengths)
+
+        return forward
+
+    node = make_node("recurrent_group", make_forward(0), graph_inputs,
+                     name=name, size=out_node_inner.size,
                      param_specs=program.param_specs)
     # propagate the inner output's activation marker so cost layers treat
     # softmax-activated step outputs as probabilities, not logits
     node.output_activation = getattr(out_node_inner, "output_activation",
                                      None)
     node._step_program = program
+    node._make_forward = make_forward
     return node
 
 
@@ -406,19 +432,17 @@ def get_output(input, arg_name=None, name=None):
     inner = program.by_name[arg_name]
 
     idx = program.outputs.index(inner) if inner in program.outputs else None
-    enforce(idx is not None or inner is program.outputs[0],
+    enforce(idx is not None,
             "get_output: inner layer %r must be returned by the step "
             "function (return a list)" % arg_name)
 
-    def forward(params, values, ctx):
-        # recompute path not needed: recurrent_group scans only its first
-        # output; extend to multi-output scan on demand
-        raise NotImplementedError(
-            "get_output for secondary step outputs lands with multi-output "
-            "scan support")
-
-    return make_node("get_output", forward, [input], name=name,
-                     size=inner.size)
+    # sibling node re-running the group's scan selecting output idx —
+    # the scans are identical so XLA CSE merges them into one loop
+    node = make_node("get_output", input._make_forward(idx),
+                     list(input.inputs), name=name, size=inner.size,
+                     param_specs=list(input.param_specs))
+    node.output_activation = getattr(inner, "output_activation", None)
+    return node
 
 
 def beam_search(step, input, bos_id, eos_id, beam_size, max_length=30,

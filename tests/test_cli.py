@@ -82,3 +82,59 @@ def test_cli_checkgrad_job(config_file):
     proc = _run_cli(["checkgrad", "--config", config_file])
     assert proc.returncode == 0, proc.stderr
     assert "checkgrad PASSED" in proc.stdout
+
+
+V1_CONFIG = '''
+from paddle_tpu.config import (settings, outputs, define_py_data_sources2,
+                               get_config_arg, AdamOptimizer)
+from paddle_tpu import layer as L, data_type as dt, activation as A
+import numpy as np
+
+hidden = get_config_arg("hidden", int, 16)
+settings(batch_size=10, learning_rate=5e-3, learning_method=AdamOptimizer())
+
+x = L.data(name="x", type=dt.dense_vector(6))
+y = L.data(name="y", type=dt.integer_value(2))
+h = L.fc(input=x, size=hidden, act=A.Tanh())
+out = L.fc(input=h, size=2, act=A.Softmax())
+outputs(L.classification_cost(input=out, label=y))
+
+
+def _reader(file_list, n=60):
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(n):
+            v = rng.randn(6).astype(np.float32)
+            yield v, int(v.sum() > 0)
+    return reader
+
+
+define_py_data_sources2(train_list="train", test_list="test",
+                        module="paddle_tpu_user_config", obj="_reader")
+'''
+
+
+def test_v1_style_config_trains(tmp_path, capsys):
+    """A reference-style settings()/outputs()/data-sources config runs
+    through the CLI (config_parser + trainer_config_helpers parity)."""
+    from paddle_tpu import cli
+
+    conf = tmp_path / "v1_conf.py"
+    conf.write_text(V1_CONFIG)
+    rc = cli.main(["train", "--config", str(conf),
+                   "--config-args", "hidden=8", "--num-passes", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "test cost=" in out
+
+
+def test_get_config_arg_types():
+    from paddle_tpu import config as cfgmod
+
+    cfgmod.reset()
+    cfgmod.set_config_args("a=3,b=true,c=hi")
+    assert cfgmod.get_config_arg("a", int) == 3
+    assert cfgmod.get_config_arg("b", bool) is True
+    assert cfgmod.get_config_arg("c") == "hi"
+    assert cfgmod.get_config_arg("missing", int, 7) == 7
+    cfgmod.reset()

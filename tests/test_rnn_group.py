@@ -236,3 +236,27 @@ def test_beam_search_memory_advances_between_steps():
 
     seqs, lengths, scores = gen.generate(params)
     assert seqs[0, 0].tolist() == [0, 1, 2]
+
+
+def test_get_output_secondary_group_output():
+    """Multi-output recurrent_group: get_output exposes a secondary step
+    output (reference: GetOutputLayer over RecurrentLayerGroup outputs)."""
+    dim = 3
+    x = L.data(name="mo_x", type=dt.dense_vector_sequence(dim))
+
+    def step(x_t):
+        mem = L.memory(name="mo_h", size=dim)
+        h = L.fc(input=[x_t, mem], size=dim, act=A.Tanh(), name="mo_h",
+                 param_attr=ParamAttr(name="mo_w"), bias_attr=False)
+        double = L.slope_intercept(input=h, slope=2.0, name="mo_double")
+        return [h, double]
+
+    group = L.recurrent_group(step=step, input=x, name="mo_group")
+    second = L.get_output(input=group, arg_name="mo_double", name="mo_sec")
+    topo = Topology([group, second])
+    params = topo.init_params(jax.random.PRNGKey(0))
+    feed = _seq_feed("mo_x", dim, lengths=(4, 2), seed=9)
+    vals, _ = topo.apply(params, feed, mode="test")
+    np.testing.assert_allclose(np.asarray(vals["mo_sec"].data),
+                               np.asarray(vals["mo_group"].data) * 2,
+                               rtol=1e-6)
