@@ -80,15 +80,19 @@ from paddle_tpu.layer.cost import (
     multi_binary_label_cross_entropy,
     rank_cost,
     regression_cost,
+    soft_binary_class_cross_entropy,
     smooth_l1_cost,
     square_error_cost,
     sum_cost,
 )
-from paddle_tpu.layer.recurrent import grumemory, lstmemory, recurrent
+from paddle_tpu.layer.recurrent import (grumemory, lstmemory,
+                                        mdlstmemory, recurrent)
 from paddle_tpu.layer.extra import (
     crf,
     crf_decoding,
     ctc,
+    data_norm,
+    featmap_expand,
     hsigmoid,
     nce,
     warp_ctc,
@@ -143,3 +147,45 @@ convex_comb = linear_comb          # reference: convex_comb_layer = deprecated
 eos = eos_id                       # reference: eos_layer
 printer = print_layer              # reference: printer_layer
 huber_cost = huber_classification_cost
+
+# ---------------------------------------------------------------------------
+# reference REGISTER_LAYER type-name aliases (gserver/layers REGISTER_LAYER
+# audit): reference config type names resolve to the equivalent constructor
+# here. agent/gather_agent/scatter_agent/recurrent_layer_group plumbing is
+# subsumed by the recurrent_group scan design (see docs/DELTAS.md).
+# ---------------------------------------------------------------------------
+import functools as _functools
+
+from paddle_tpu.layer.base import layer_registry as _registry
+
+for _ref_name, _our_name in {
+    "exconv": "img_conv", "cudnn_conv": "img_conv",
+    "cudnn_batch_norm": "batch_norm",
+    "seqlastins": "last_seq", "seqconcat": "seq_concat",
+    "seqreshape": "seq_reshape", "subseq": "sub_seq",
+    "blockexpand": "block_expand", "maxid": "max_id",
+    "cos": "cos_sim", "cos_vm": "cos_sim",
+    "convex_comb": "linear_comb", "concat2": "concat",
+    "huber": "huber_classification_cost",
+    "square_error": "square_error_cost", "smooth_l1": "smooth_l1_cost",
+    "gated_recurrent": "grumemory",
+    "multi_class_cross_entropy_with_selfnorm": "cross_entropy_with_selfnorm",
+    "recurrent_layer_group": "recurrent_group",
+    "warp_ctc": "ctc",
+}.items():
+    if _ref_name not in _registry._entries:
+        _registry._entries[_ref_name] = _registry.get(_our_name)
+
+# names that select behavior in the reference must bind it here too
+from paddle_tpu import pooling as _pooling
+
+for _ref_name, _bound in {
+    "exconvt": _functools.partial(img_conv, trans=True),
+    "cudnn_convt": _functools.partial(img_conv, trans=True),
+    "average": _functools.partial(pooling,
+                                  pooling_type=_pooling.AvgPooling()),
+    "max": _functools.partial(pooling,
+                              pooling_type=_pooling.MaxPooling()),
+}.items():
+    if _ref_name not in _registry._entries:
+        _registry._entries[_ref_name] = _bound

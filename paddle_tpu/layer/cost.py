@@ -16,7 +16,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.activation import Softmax
 from paddle_tpu.core.sequence import SequenceBatch
-from paddle_tpu.layer.base import data_of, is_seq, make_node, register_layer
+from paddle_tpu.layer.base import (data_of, is_seq, layer_registry,
+                                  make_node, register_layer)
 from paddle_tpu.utils.error import enforce
 
 _EPS = 1e-8
@@ -239,3 +240,10 @@ def sum_cost(input, name=None, layer_attr=None):
 
     return make_node("sum_cost", forward, [input], name=name, size=1,
                      layer_attr=layer_attr)
+
+
+# reference SoftBinaryClassCrossEntropy (CostLayer.cpp): identical math to
+# the multi-binary-label CE — the label is per-unit probabilities there too
+soft_binary_class_cross_entropy = multi_binary_label_cross_entropy
+layer_registry.register("soft_binary_class_cross_entropy",
+                        multi_binary_label_cross_entropy)

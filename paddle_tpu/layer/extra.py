@@ -16,6 +16,7 @@ from paddle_tpu.layer.base import (
     bias_spec,
     data_of,
     is_seq,
+    like,
     make_node,
     register_layer,
     weight_spec,
@@ -206,3 +207,70 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
 
     return make_node("hsigmoid", forward, [input, label], name=name, size=1,
                      param_specs=[wspec, bspec], layer_attr=layer_attr)
+
+
+# ---------------------------------------------------------------------------
+# remaining reference layer types (REGISTER_LAYER audit)
+# ---------------------------------------------------------------------------
+@register_layer("data_norm")
+def data_norm(input, data_norm_strategy="z-score", name=None,
+              param_attr=None, layer_attr=None):
+    """Input normalization from precomputed statistics (reference:
+    DataNormLayer — z-score / min-max / decimal-scaling using stats shipped
+    as a (non-trained) parameter of shape [5, D]: rows = mean, std, min,
+    max, decimal-scale, matching the reference's stats layout)."""
+    from paddle_tpu.graph import auto_name
+    from paddle_tpu.attr import ParamAttr
+
+    name = name or auto_name("data_norm")
+    size = input.size
+    import copy
+
+    # copy: never mutate a caller's (possibly shared) ParamAttr
+    attr = copy.copy(ParamAttr.to_attr(param_attr))
+    attr.is_static = True  # stats are data, not trained
+    if attr.initializer is None:
+        attr.initializer = Constant(0.0)
+    wspec = weight_spec(name, 0, (5, size), attr, fan_in=size)
+
+    def forward(params, values, ctx):
+        x = data_of(values[0])
+        stats = params[wspec.name]
+        mean, std = stats[0], stats[1]
+        lo, hi, dec = stats[2], stats[3], stats[4]
+        if data_norm_strategy == "z-score":
+            out = (x - mean) / (std + _EPS)
+        elif data_norm_strategy == "min-max":
+            out = (x - lo) / (hi - lo + _EPS)
+        elif data_norm_strategy == "decimal-scaling":
+            out = x / (dec + _EPS)
+        else:
+            raise ValueError("unknown data_norm_strategy %r"
+                             % data_norm_strategy)
+        return like(values[0], out)
+
+    return make_node("data_norm", forward, [input], name=name, size=size,
+                     param_specs=[wspec], layer_attr=layer_attr)
+
+
+@register_layer("featmap_expand")
+def featmap_expand(input, num_filters, as_row_vector=True, name=None,
+                   layer_attr=None):
+    """Tile the feature map across ``num_filters`` copies (reference:
+    FeatureMapExpandLayer — expands [.., C] to [.., C*num_filters]; with
+    as_row_vector the copies are repeated featmap-wise, else
+    element-wise)."""
+    from paddle_tpu.graph import auto_name
+
+    name = name or auto_name("featmap_expand")
+
+    def forward(params, values, ctx):
+        x = data_of(values[0])
+        if as_row_vector:
+            out = jnp.concatenate([x] * num_filters, axis=-1)
+        else:
+            out = jnp.repeat(x, num_filters, axis=-1)
+        return like(values[0], out)
+
+    return make_node("featmap_expand", forward, [input], name=name,
+                     size=input.size * num_filters, layer_attr=layer_attr)

@@ -15,7 +15,9 @@ from paddle_tpu.activation import to_activation
 from paddle_tpu.core.sequence import SequenceBatch
 from paddle_tpu.layer.base import (
     bias_spec,
+    data_of,
     is_seq,
+    like,
     make_node,
     register_layer,
     weight_spec,
@@ -148,3 +150,49 @@ def recurrent(input, name=None, act=None, reverse=False, bias_attr=None,
     specs = [s for s in (wspec, bspec) if s is not None]
     return make_node("recurrent", forward, [input], name=name, size=size,
                      param_specs=specs, layer_attr=layer_attr)
+
+
+@register_layer("mdlstmemory", aliases=("mdlstm",))
+def mdlstmemory(input, size, directions=(True, True), name=None,
+                param_attr=None, bias_attr=None, layer_attr=None):
+    """Two-dimensional LSTM over image-shaped input (reference:
+    MDLstmLayer.cpp / mdlstmemory DSL — Graves multi-dimensional LSTM with
+    per-axis direction flags). ``input`` must carry ``out_img_shape``
+    (C, H, W); output is img-shaped (size, H, W). ``directions[k]=False``
+    sweeps axis k in reverse (the reference's 4-direction MDLSTM is four of
+    these layers concatenated)."""
+    from paddle_tpu.graph import auto_name
+    from paddle_tpu.layer.conv import _img_shape, _to_nhwc
+
+    c, h, w = _img_shape(input)
+    name = name or auto_name("mdlstm")
+    wx = weight_spec(name, 0, (c, 5 * size), param_attr, fan_in=c)
+    wup = weight_spec(name, 1, (size, 5 * size), param_attr, fan_in=size)
+    wleft = weight_spec(name, 2, (size, 5 * size), param_attr, fan_in=size)
+    bspec = bias_spec(name, (5 * size,), bias_attr
+                      if bias_attr is not None else True)
+
+    def forward(params, values, ctx):
+        x = _to_nhwc(data_of(values[0]), c, h, w)
+        if not directions[0]:
+            x = x[:, ::-1]
+        if not directions[1]:
+            x = x[:, :, ::-1]
+        bias = params[bspec.name] if bspec is not None else 0.0
+        out = rnn_ops.mdlstm_2d(x, params[wx.name], params[wup.name],
+                                params[wleft.name], bias, size)
+        if not directions[0]:
+            out = out[:, ::-1]
+        if not directions[1]:
+            out = out[:, :, ::-1]
+        # NHWC -> flat NCHW-vector (the conv-layer boundary convention)
+        flat = out.transpose(0, 3, 1, 2).reshape(out.shape[0], -1)
+        return like(values[0], flat)
+
+    node = make_node("mdlstmemory", forward, [input], name=name,
+                     size=size * h * w,
+                     param_specs=[sp for sp in (wx, wup, wleft, bspec)
+                                  if sp is not None],
+                     layer_attr=layer_attr)
+    node.out_img_shape = (size, h, w)
+    return node

@@ -221,3 +221,57 @@ def rnn_scan(x_btd, mask_bt, w_rec, h0=None, act=jnp.tanh, reverse=False):
         sb = SequenceBatch(h_seq, jnp.sum(mask_bt, axis=1).astype(jnp.int32))
         h_seq = sb.reverse().data
     return h_seq * mask_bt[..., None], h_f
+
+
+def mdlstm_2d(x_img, w_x, w_h_up, w_h_left, bias, size):
+    """Two-dimensional LSTM sweep (reference: MDLstmLayer.cpp — Graves-style
+    multi-dimensional LSTM): every cell sees its up and left neighbors,
+
+        c[i,j] = f1*c[i-1,j] + f2*c[i,j-1] + i*g
+        h[i,j] = o * tanh(c[i,j])
+
+    with gates (i, f_up, f_left, o, g) from x[i,j], h[i-1,j], h[i,j-1].
+    Implemented as a scan over rows whose body scans over columns — the
+    true dependency wavefront, compiled by XLA into two nested fori loops.
+
+    x_img: [B, H, W, C]; w_x: [C, 5*size]; w_h_up/w_h_left: [size, 5*size];
+    bias: [5*size]. Returns h: [B, H, W, size].
+    """
+    batch, height, width, _ = x_img.shape
+    gx = jnp.einsum("bhwc,cg->bhwg", x_img, w_x) + bias  # [B,H,W,5S]
+    gx_hm = jnp.moveaxis(gx, 1, 0)  # [H, B, W, 5S]
+    zeros_row = (jnp.zeros((batch, width, size), gx.dtype),
+                 jnp.zeros((batch, width, size), gx.dtype))
+
+    def split(g):
+        return (g[..., :size], g[..., size:2 * size],
+                g[..., 2 * size:3 * size], g[..., 3 * size:4 * size],
+                g[..., 4 * size:])
+
+    def row_body(row_carry, gx_row):
+        h_up_row, c_up_row = row_carry        # [B, W, S] from row above
+        gx_wm = jnp.moveaxis(gx_row, 1, 0)    # [W, B, 5S]
+        h_up_wm = jnp.moveaxis(h_up_row, 1, 0)
+        c_up_wm = jnp.moveaxis(c_up_row, 1, 0)
+
+        def col_body(col_carry, inp):
+            h_left, c_left = col_carry        # [B, S]
+            gx_t, h_up, c_up = inp
+            g = gx_t + h_up @ w_h_up + h_left @ w_h_left
+            i, f_up, f_left, o, cand = split(g)
+            c = (jax.nn.sigmoid(f_up) * c_up
+                 + jax.nn.sigmoid(f_left) * c_left
+                 + jax.nn.sigmoid(i) * jnp.tanh(cand))
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), (h, c)
+
+        init = (jnp.zeros((batch, size), gx.dtype),
+                jnp.zeros((batch, size), gx.dtype))
+        _, (h_wm, c_wm) = lax.scan(col_body, init,
+                                   (gx_wm, h_up_wm, c_up_wm))
+        h_row = jnp.moveaxis(h_wm, 0, 1)      # [B, W, S]
+        c_row = jnp.moveaxis(c_wm, 0, 1)
+        return (h_row, c_row), h_row
+
+    _, h_hm = lax.scan(row_body, zeros_row, gx_hm)  # [H, B, W, S]
+    return jnp.moveaxis(h_hm, 0, 1)                 # [B, H, W, S]

@@ -277,3 +277,139 @@ def test_detection_output_shapes_and_sanity():
     assert ((labels[valid] == 1) | (labels[valid] == 2)).all()
     bx = out[valid][:, 3:]
     assert (bx >= 0).all() and (bx <= 1).all()
+
+
+def test_mdlstm_matches_numpy_reference():
+    """mdlstmemory vs a literal numpy 2-D LSTM recurrence (reference:
+    MDLstmLayer.cpp gate order i, f_up, f_left, o, g)."""
+    import jax
+
+    from paddle_tpu import layer as L, data_type as dt
+    from paddle_tpu.topology import Topology
+
+    C, H, W, S = 2, 3, 4, 3
+    x = L.data(name="md_x", type=dt.dense_vector(C * H * W))
+    x.out_img_shape = (C, H, W)
+    out = L.mdlstmemory(input=x, size=S, name="md")
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(3))
+    rng = np.random.RandomState(0)
+    img = rng.randn(2, C * H * W).astype(np.float32)
+    vals, _ = topo.apply(params, {"md_x": img}, mode="test")
+    got = np.asarray(vals[out.name]).reshape(2, S, H, W)
+
+    def sig(a):
+        return 1.0 / (1.0 + np.exp(-a))
+
+    wx, wu, wl = (np.asarray(params["md.w0"]), np.asarray(params["md.w1"]),
+                  np.asarray(params["md.w2"]))
+    b = np.asarray(params["md.wbias"])
+    x_nhwc = img.reshape(2, C, H, W).transpose(0, 2, 3, 1)
+    hbuf = np.zeros((2, H, W, S))
+    cbuf = np.zeros((2, H, W, S))
+    for i in range(H):
+        for j in range(W):
+            h_up = hbuf[:, i - 1, j] if i > 0 else np.zeros((2, S))
+            c_up = cbuf[:, i - 1, j] if i > 0 else np.zeros((2, S))
+            h_left = hbuf[:, i, j - 1] if j > 0 else np.zeros((2, S))
+            c_left = cbuf[:, i, j - 1] if j > 0 else np.zeros((2, S))
+            g = x_nhwc[:, i, j] @ wx + h_up @ wu + h_left @ wl + b
+            ii, fu, fl, o, cand = (g[:, :S], g[:, S:2 * S], g[:, 2 * S:3 * S],
+                                   g[:, 3 * S:4 * S], g[:, 4 * S:])
+            cbuf[:, i, j] = (sig(fu) * c_up + sig(fl) * c_left
+                             + sig(ii) * np.tanh(cand))
+            hbuf[:, i, j] = sig(o) * np.tanh(cbuf[:, i, j])
+    want = hbuf.transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_mdlstm_direction_flags_and_grad():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import layer as L, data_type as dt
+    from paddle_tpu.topology import Topology
+
+    C, H, W, S = 2, 3, 3, 2
+    x = L.data(name="mdr_x", type=dt.dense_vector(C * H * W))
+    x.out_img_shape = (C, H, W)
+    out = L.mdlstmemory(input=x, size=S, directions=(False, True),
+                        name="mdr")
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(1))
+    rng = np.random.RandomState(1)
+    img = jnp.asarray(rng.randn(1, C * H * W), jnp.float32)
+
+    def loss(p):
+        vals, _ = topo.apply(p, {"mdr_x": img}, mode="test")
+        return jnp.sum(vals[out.name] ** 2)
+
+    g = jax.grad(loss)(params)
+    for k in ("mdr.w0", "mdr.w1", "mdr.w2", "mdr.wbias"):
+        assert float(jnp.abs(g[k]).max()) > 0, k
+
+
+def test_data_norm_strategies():
+    from paddle_tpu.topology import Topology
+
+    x = L.data(name="dn_x", type=dt.dense_vector(3))
+    out = L.data_norm(input=x, data_norm_strategy="z-score", name="dn")
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    stats = np.zeros((5, 3), np.float32)
+    stats[0] = [1.0, 2.0, 3.0]   # mean
+    stats[1] = [2.0, 2.0, 2.0]   # std
+    params = dict(params); params["dn.w0"] = jnp.asarray(stats)
+    feed = np.asarray([[3.0, 2.0, 7.0]], np.float32)
+    vals, _ = topo.apply(params, {"dn_x": feed}, mode="test")
+    np.testing.assert_allclose(np.asarray(vals["dn"]), [[1.0, 0.0, 2.0]],
+                               rtol=1e-5)
+    # stats are static: excluded from training partition
+    from paddle_tpu.parameters import Parameters
+
+    p = Parameters.create(out)
+    trainable, static, _ = p.partition()
+    assert "dn.w0" in static and "dn.w0" not in trainable
+
+
+def test_featmap_expand_modes():
+    from paddle_tpu.topology import Topology
+
+    x = L.data(name="fe_x", type=dt.dense_vector(2))
+    row = L.featmap_expand(input=x, num_filters=3, name="fe_row")
+    el = L.featmap_expand(input=x, num_filters=3, as_row_vector=False,
+                          name="fe_el")
+    topo = Topology([row, el])
+    params = topo.init_params(jax.random.PRNGKey(0))
+    vals, _ = topo.apply(params, {"fe_x": np.asarray([[1.0, 2.0]],
+                                                     np.float32)},
+                         mode="test")
+    np.testing.assert_array_equal(np.asarray(vals["fe_row"]),
+                                  [[1, 2, 1, 2, 1, 2]])
+    np.testing.assert_array_equal(np.asarray(vals["fe_el"]),
+                                  [[1, 1, 1, 2, 2, 2]])
+
+
+def test_soft_binary_cross_entropy():
+    from paddle_tpu.topology import Topology
+
+    p_in = L.data(name="sb_p", type=dt.dense_vector(2))
+    y_in = L.data(name="sb_y", type=dt.dense_vector(2))
+    cost = L.soft_binary_class_cross_entropy(input=p_in, label=y_in,
+                                             name="sb")
+    topo = Topology(cost)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    p = np.asarray([[0.7, 0.2]], np.float32)
+    y = np.asarray([[0.5, 0.1]], np.float32)
+    vals, _ = topo.apply(params, {"sb_p": p, "sb_y": y}, mode="test")
+    want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).sum()
+    np.testing.assert_allclose(np.asarray(vals["sb"])[0], want, rtol=1e-4)
+
+
+def test_reference_layer_name_aliases():
+    from paddle_tpu.layer.base import layer_registry
+
+    for ref_name in ("exconv", "seqlastins", "maxid", "cos", "huber",
+                     "blockexpand", "gated_recurrent", "warp_ctc",
+                     "mdlstmemory"):
+        assert ref_name in layer_registry._entries, ref_name
