@@ -46,10 +46,15 @@ def chain_slope_ms(step, carry, fetch, n1=10, n2=110):
     return max(t2 - t1, 1e-9) / (n2 - n1) * 1000.0, carry
 
 
-def _train_step_harness(topo, cost_name, optimizer, feed_of, data):
+def _train_step_harness(topo, cost_name, optimizer, feed_of, data,
+                        dp_mesh=None):
     """Carry = (loss, params, opt_state): the loss rides in the carry so
     fetch() is a scalar device->host read and chained steps data-depend on
-    each other through the donated params."""
+    each other through the donated params.
+
+    With ``dp_mesh`` (a Mesh with a 'data' axis) the batch is pre-sharded
+    over the axis and params/opt state replicated — XLA partitions the
+    step and inserts the gradient psum (pserver-free data parallelism)."""
     import jax
     import jax.numpy as jnp
 
@@ -65,13 +70,24 @@ def _train_step_harness(topo, cost_name, optimizer, feed_of, data):
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
     params = topo.init_params(jax.random.PRNGKey(0))
     opt_state = optimizer.init_state(params)
-    carry = (jnp.zeros(()), params, opt_state)
+    loss0 = jnp.zeros(())
+    if dp_mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        batch_sh = NamedSharding(dp_mesh, P("data"))
+        repl = NamedSharding(dp_mesh, P())
+        data = tuple(jax.device_put(d, batch_sh) for d in data)
+        params = jax.tree.map(lambda a: jax.device_put(a, repl), params)
+        opt_state = jax.tree.map(lambda a: jax.device_put(a, repl),
+                                 opt_state)
+        loss0 = jax.device_put(loss0, repl)
+    carry = (loss0, params, opt_state)
     return (lambda c: jitted(c[1], c[2], *data)), carry, \
         (lambda c: float(c[0]))
 
 
 def build_rnn_step(batch, hidden, seqlen=100, dict_size=30000, emb=128,
-                   classes=2, lr=0.01):
+                   classes=2, lr=0.01, dp_mesh=None):
     """Flagship RNN benchmark: 2x LSTM + fc text classifier, padded
     sequences (BASELINE.md RNN table)."""
     import jax.numpy as jnp
@@ -97,7 +113,8 @@ def build_rnn_step(batch, hidden, seqlen=100, dict_size=30000, emb=128,
         jnp.full((batch,), seqlen, jnp.int32),  # reference pads to seqlen
         jnp.asarray(rng.randint(0, classes, (batch,)), jnp.int32),
     )
-    return _train_step_harness(topo, cost.name, optimizer, feed_of, data)
+    return _train_step_harness(topo, cost.name, optimizer, feed_of, data,
+                               dp_mesh=dp_mesh)
 
 
 IMAGE_MODELS = {
@@ -108,7 +125,7 @@ IMAGE_MODELS = {
 }
 
 
-def build_image_step(model_name, batch, lr=0.01):
+def build_image_step(model_name, batch, lr=0.01, dp_mesh=None):
     """CNN benchmarks (BASELINE.md CNN table)."""
     import jax.numpy as jnp
 
@@ -133,4 +150,5 @@ def build_image_step(model_name, batch, lr=0.01):
     rng = np.random.RandomState(0)
     data = (jnp.asarray(rng.randn(batch, in_dim), jnp.float32),
             jnp.asarray(rng.randint(0, classes, batch), jnp.int32))
-    return _train_step_harness(topo, cost.name, optimizer, feed_of, data)
+    return _train_step_harness(topo, cost.name, optimizer, feed_of, data,
+                               dp_mesh=dp_mesh)

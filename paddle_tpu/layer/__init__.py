@@ -173,8 +173,8 @@ for _ref_name, _our_name in {
     "recurrent_layer_group": "recurrent_group",
     "warp_ctc": "ctc",
 }.items():
-    if _ref_name not in _registry._entries:
-        _registry._entries[_ref_name] = _registry.get(_our_name)
+    if _ref_name not in _registry:
+        _registry.register(_ref_name, _registry.get(_our_name))
 
 # names that select behavior in the reference must bind it here too
 from paddle_tpu import pooling as _pooling
@@ -187,5 +187,5 @@ for _ref_name, _bound in {
     "max": _functools.partial(pooling,
                               pooling_type=_pooling.MaxPooling()),
 }.items():
-    if _ref_name not in _registry._entries:
-        _registry._entries[_ref_name] = _bound
+    if _ref_name not in _registry:
+        _registry.register(_ref_name, _bound)

@@ -1,0 +1,26 @@
+"""Force the CPU backend for virtual-mesh runs.
+
+The axon sitecustomize registers the TPU-tunnel PJRT plugin at interpreter
+start; virtual-mesh tools (tests, scaling harness) must drop it and pin the
+live config to cpu BEFORE any device is touched. One shared copy of the
+(private-API) scrub so a JAX upgrade breaks exactly one place.
+"""
+
+import os
+
+
+def force_cpu_backend():
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        from jax._src import xla_bridge as xb
+
+        jax.config.update("jax_platforms", "cpu")
+        for name in list(xb._backend_factories):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+        if hasattr(xb.backends, "cache_clear"):
+            xb.backends.cache_clear()
+    except Exception:
+        pass

@@ -14,25 +14,15 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 
-def _strip_accel_backends():
-    """Deregister non-CPU PJRT backends registered by sitecustomize before
-    this conftest ran, so no test can trigger a (possibly hung) tunnel init."""
-    try:
-        import jax
-        from jax._src import xla_bridge as xb
+import sys as _sys
 
-        # sitecustomize may have imported jax already with
-        # JAX_PLATFORMS=axon baked in; force the live config to cpu.
-        jax.config.update("jax_platforms", "cpu")
-        for name in list(xb._backend_factories):
-            if name != "cpu":
-                xb._backend_factories.pop(name, None)
-        xb.backends.cache_clear() if hasattr(xb.backends, "cache_clear") else None
-    except Exception:
-        pass
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from paddle_tpu.utils.cpu_mesh import force_cpu_backend
 
-_strip_accel_backends()
+# Deregister non-CPU PJRT backends registered by sitecustomize before this
+# conftest ran, so no test can trigger a (possibly hung) tunnel init.
+force_cpu_backend()
 _flag = "--xla_force_host_platform_device_count=8"
 if _flag not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()

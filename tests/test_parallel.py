@@ -119,3 +119,22 @@ def test_graft_entry_compiles():
     out = jax.jit(fn)(*args)
     assert out.shape[0] == args[1].shape[0]
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_benchmark_harness_dp_matches_single_device():
+    """The benchmark scaling harness's mesh path computes the SAME losses
+    as the single-device path (lockstep comparison, test_CompareTwoNets
+    pattern applied to the harness itself)."""
+    import jax
+
+    from paddle_tpu.parallel.mesh import build_mesh
+    from benchmark.harness import build_image_step
+
+    step1, carry1, fetch1 = build_image_step("smallnet", 16)
+    mesh = build_mesh({"data": 8})
+    stepN, carryN, fetchN = build_image_step("smallnet", 16, dp_mesh=mesh)
+    for _ in range(3):
+        carry1 = step1(carry1)
+        carryN = stepN(carryN)
+        np.testing.assert_allclose(fetch1(carry1), fetchN(carryN),
+                                   rtol=2e-4)
