@@ -129,13 +129,16 @@ class SGD:
 
     # -- main loop ----------------------------------------------------------
     def train(self, reader, num_passes=1, event_handler=None, feeding=None,
-              sync_params=True):
+              sync_params=True, test_reader=None):
         """Event-driven training (v2 SGD.train parity). ``reader`` yields
-        minibatches (lists of sample tuples)."""
+        minibatches (lists of sample tuples). With ``test_reader`` and a
+        nonzero ``test_period`` flag, an evaluation pass runs every N
+        batches (reference: Tester::testOnePeriod, --test_period)."""
         if event_handler is None:
             event_handler = default_event_handler
         feeding = feeding or self.feeding
         log_period = flags.get_flag("log_period")
+        test_period = flags.get_flag("test_period")
 
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
@@ -165,9 +168,23 @@ class SGD:
                 psp = flags.get_flag("show_parameter_stats_period")
                 if psp and self._step_count % psp == 0:
                     self._log_param_stats()
+                if (test_reader is not None and test_period
+                        and self._step_count % test_period == 0):
+                    result = self.test(test_reader, feeding=feeding,
+                                       pass_id=pass_id)
+                    logger.info("periodic test: cost=%.6f %s", result.cost,
+                                _fmt_metrics(result.metrics))
+                    event_handler(result)
                 event_handler(v2_event.EndIteration(
                     pass_id, batch_id, float(loss), metrics))
                 batch_id += 1
+            if test_reader is not None and not test_period:
+                # flag default 0 = one test pass per training pass
+                result = self.test(test_reader, feeding=feeding,
+                                   pass_id=pass_id)
+                logger.info("pass %d test: cost=%.6f %s", pass_id,
+                            result.cost, _fmt_metrics(result.metrics))
+                event_handler(result)
             if sync_params:
                 self._sync_back()
             event_handler(v2_event.EndPass(
@@ -177,7 +194,7 @@ class SGD:
         if sync_params:
             self._sync_back()
 
-    def test(self, reader, feeding=None):
+    def test(self, reader, feeding=None, pass_id=0):
         """One evaluation pass; returns a TestResult event (v2 SGD.test)."""
         feeding = feeding or self.feeding
         eval_acc = {e.name: None for e in self.evaluators}
@@ -193,7 +210,7 @@ class SGD:
                                            jax.device_get(stats[e.name]))
         metrics = {e.name: e.result(eval_acc[e.name]) for e in self.evaluators}
         return v2_event.TestResult(
-            0, total_cost / max(n_batches, 1), metrics)
+            pass_id, total_cost / max(n_batches, 1), metrics)
 
     # -- observability (Flags.cpp:71 --show_layer_stat;
     # TrainerInternal.cpp:100-110 --show_param_stats_period) ----------------

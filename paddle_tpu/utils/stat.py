@@ -90,3 +90,17 @@ class StatSet:
 
 global_stats = StatSet("global")
 timer = global_stats.timer
+
+
+@contextmanager
+def profiler_trace(logdir="/tmp/paddle_tpu_trace"):
+    """Capture an xprof/TensorBoard device trace for the enclosed region
+    (reference: hl_profiler_start/hl_profiler_end, hl_cuda.h — the CUDA
+    profiler window; here jax.profiler, viewable with xprof/TensorBoard)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()

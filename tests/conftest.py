@@ -7,11 +7,6 @@ path; bench.py runs on the real chip). Must set XLA flags before jax imports.
 
 import os
 
-# Drop the axon TPU-tunnel registration (sitecustomize registers the axon
-# PJRT plugin when this var is set; tests must stay CPU-only and must not
-# touch — or hang on — the single real chip's tunnel).
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
 
 
 import sys as _sys

@@ -250,3 +250,57 @@ def test_sparse_embedding_training_only_touches_used_rows():
     after = params.get("semb_table")
     np.testing.assert_array_equal(after[10:], before[10:])
     assert not np.allclose(after[:10], before[:10])
+
+
+def test_periodic_test_pass_via_test_period_flag():
+    from paddle_tpu.utils import flags as fl
+
+    x, lab, out, cost = _toy_classification_net(dim=4, classes=2)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Momentum(learning_rate=0.1))
+    results = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.TestResult):
+            results.append(e)
+
+    fl.set_flag("test_period", 2)
+    try:
+        trainer.train(
+            minibatch.batch(_toy_reader(dim=4, classes=2, n=32), 4),
+            num_passes=1, event_handler=handler,
+            test_reader=minibatch.batch(_toy_reader(dim=4, classes=2, n=8),
+                                        4))
+    finally:
+        fl.set_flag("test_period", 0)
+    assert len(results) == 4  # 8 batches / period 2
+
+
+def test_profiler_trace_writes(tmp_path):
+    import jax.numpy as jnp
+
+    from paddle_tpu.utils.stat import profiler_trace
+
+    with profiler_trace(str(tmp_path)) as logdir:
+        jnp.ones((8, 8)).sum().block_until_ready()
+    import os
+
+    found = any("trace" in f or f.endswith(".pb") or "plugins" in d
+                for d, _, fs in os.walk(logdir) for f in fs + [""])
+    assert found
+
+
+def test_test_reader_runs_per_pass_by_default():
+    x, lab, out, cost = _toy_classification_net(dim=4, classes=2)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Momentum(learning_rate=0.1))
+    results = []
+    trainer.train(
+        minibatch.batch(_toy_reader(dim=4, classes=2, n=16), 4),
+        num_passes=3,
+        event_handler=lambda e: results.append(e)
+        if isinstance(e, paddle.event.TestResult) else None,
+        test_reader=minibatch.batch(_toy_reader(dim=4, classes=2, n=8), 4))
+    assert [r.pass_id for r in results] == [0, 1, 2]
