@@ -1,11 +1,20 @@
-"""Benchmark driver: flagship LSTM text-classification training step.
+"""Benchmark driver (reference parity: `paddle train --job=time`).
 
-Mirrors the reference's headline RNN benchmark (BASELINE.md: 2x LSTM + fc,
-IMDB, seq len 100 padded, dict 30k, batch 64, hidden 256 — PaddlePaddle
-83 ms/batch, TF 175 ms/batch on a K40m; reference driver `paddle train
---job=time`, benchmark/paddle/rnn/run.sh). Measures steady-state wall time
-of the fused train step (forward + backward + optimizer) on the real chip
-and prints ONE JSON line; vs_baseline > 1 means faster than the reference.
+Emits ONE JSON line per metric, most-important (flagship LSTM) LAST so a
+last-line parser still gets the headline number. Each line:
+
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "repeats": k, "spread_pct": s}
+
+vs_baseline > 1 means faster/better than the reference baseline
+(BASELINE.md K40m tables; for ResNet-50 — not in the 2017 tables — the
+north-star target of 2,000 samples/s/chip from BASELINE.json).
+
+Before any timing, a **numerical gate** runs on the real chip: the fused
+Pallas LSTM/GRU kernels (resident f32, resident bf16, tiled f32/bf16
+h=1280) are checked against the lax.scan path for forward AND gradients; a
+mismatch aborts the whole benchmark — a wrong kernel cannot ship a good
+number (VERDICT r1 item 3).
 
 The full published-table suite lives in benchmark/run.py; both share
 benchmark/harness.py (step construction + slope timing).
@@ -13,25 +22,252 @@ benchmark/harness.py (step construction + slope timing).
 
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-BASELINE_MS = 83.0  # benchmark/README.md:119 — LSTM bs=64 h=256, K40m
+GATE_TOL = {"float32": 2e-3, "bfloat16": 8e-2}
+
+
+class GateFailure(RuntimeError):
+    """A fused kernel disagreed with the lax.scan reference."""
+
+
+def _gate_require(cond, msg):
+    # explicit raise (not `assert`): `python -O` must not strip the gate
+    if not cond:
+        raise GateFailure(msg)
+
+
+def _gate_check_lstm(hidden, dtype_name, batch=8, t=12):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_kernels as pk
+    from paddle_tpu.ops import rnn as rnn_ops
+
+    dtype = jnp.dtype(dtype_name)
+    mode = pk.lstm_mode(batch, hidden, dtype)
+    _gate_require(mode is not None, "no fused mode for h=%d %s"
+                  % (hidden, dtype_name))
+    rng = np.random.RandomState(hidden)
+    gates = jnp.asarray(rng.randn(batch, t, 4 * hidden) * 0.3, dtype)
+    lengths = rng.randint(1, t + 1, batch)
+    lengths[0] = t
+    mask = jnp.asarray(np.arange(t)[None, :] < lengths[:, None], jnp.float32)
+    w = jnp.asarray(rng.randn(hidden, 4 * hidden) / np.sqrt(hidden), dtype)
+    sel = jnp.asarray(rng.randn(batch, t, hidden), jnp.float32)
+    sf = jnp.asarray(rng.randn(batch, hidden), jnp.float32)
+
+    def loss(standard, g, w):
+        h_seq, (h_f, c_f) = rnn_ops.lstm_scan(
+            g, mask, None, None, w, standard_acts=standard)
+        return (jnp.sum(h_seq.astype(jnp.float32) * sel)
+                + jnp.sum(h_f.astype(jnp.float32) * sf)
+                + 0.5 * jnp.sum(c_f.astype(jnp.float32) * sf))
+
+    @jax.jit
+    def both(g, w):
+        ref, gr = jax.value_and_grad(lambda g, w: loss(False, g, w),
+                                     argnums=(0, 1))(g, w)
+        fus, gf = jax.value_and_grad(lambda g, w: loss(True, g, w),
+                                     argnums=(0, 1))(g, w)
+        return ref, fus, gr, gf
+
+    ref, fus, gr, gf = jax.device_get(both(gates, w))
+    tol = GATE_TOL[dtype_name]
+    scale = max(1.0, abs(float(ref)))
+    _gate_require(
+        abs(float(fus) - float(ref)) / scale < tol,
+        "lstm fwd mismatch h=%d %s: %r vs %r" % (hidden, dtype_name,
+                                                 float(fus), float(ref)))
+    for got, want, nm in ((gf[0], gr[0], "dgates"), (gf[1], gr[1], "dw")):
+        got32 = np.asarray(got, np.float32)
+        want32 = np.asarray(want, np.float32)
+        denom = max(1.0, float(np.abs(want32).max()))
+        err = float(np.abs(got32 - want32).max()) / denom
+        _gate_require(err < tol, "lstm %s grad mismatch h=%d %s: rel %.4g"
+                      % (nm, hidden, dtype_name, err))
+    return "lstm[h=%d,%s,%s]" % (hidden, dtype_name, mode)
+
+
+def _gate_check_gru(hidden, dtype_name, batch=8, t=12):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_kernels as pk
+    from paddle_tpu.ops import rnn as rnn_ops
+
+    dtype = jnp.dtype(dtype_name)
+    _gate_require(pk.gru_mode(batch, hidden, dtype) is not None,
+                  "no fused gru mode for h=%d %s" % (hidden, dtype_name))
+    rng = np.random.RandomState(hidden + 7)
+    proj = jnp.asarray(rng.randn(batch, t, 3 * hidden) * 0.3, dtype)
+    lengths = rng.randint(1, t + 1, batch)
+    lengths[0] = t
+    mask = jnp.asarray(np.arange(t)[None, :] < lengths[:, None], jnp.float32)
+    w_rz = jnp.asarray(rng.randn(hidden, 2 * hidden) / np.sqrt(hidden), dtype)
+    w_c = jnp.asarray(rng.randn(hidden, hidden) / np.sqrt(hidden), dtype)
+    sel = jnp.asarray(rng.randn(batch, t, hidden), jnp.float32)
+
+    def loss(fused, p, wrz, wc):
+        old = pk.gru_mode
+        if not fused:
+            pk.gru_mode = lambda *a: None
+        try:
+            h_seq, h_f = rnn_ops.gru_scan(p, mask, None, None, wrz, wc)
+        finally:
+            pk.gru_mode = old
+        return (jnp.sum(h_seq.astype(jnp.float32) * sel)
+                + jnp.sum(h_f.astype(jnp.float32)))
+
+    ref, gr = jax.value_and_grad(lambda *a: loss(False, *a),
+                                 argnums=(0, 1, 2))(proj, w_rz, w_c)
+    fus, gf = jax.value_and_grad(lambda *a: loss(True, *a),
+                                 argnums=(0, 1, 2))(proj, w_rz, w_c)
+    import jax as _jax
+
+    tol = GATE_TOL[dtype_name]
+    scale = max(1.0, abs(float(ref)))
+    _gate_require(abs(float(fus) - float(ref)) / scale < tol,
+                  "gru fwd mismatch")
+    for got, want, nm in zip(gf, gr, ("dproj", "dw_rz", "dw_c")):
+        got32 = np.asarray(_jax.device_get(got), np.float32)
+        want32 = np.asarray(_jax.device_get(want), np.float32)
+        denom = max(1.0, float(np.abs(want32).max()))
+        err = float(np.abs(got32 - want32).max()) / denom
+        _gate_require(err < tol, "gru %s grad mismatch: rel %.4g" % (nm, err))
+    return "gru[h=%d,%s]" % (hidden, dtype_name)
+
+
+def numeric_gate():
+    """Fused-vs-scan allclose for forward AND gradients, on this backend
+    (the real chip under the driver env). Raises on mismatch."""
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    if not pk.enabled():
+        return {"metric": "fused_kernel_numeric_gate", "value": 0,
+                "unit": "checks", "note": "pallas unavailable; scan path"}
+    checked = [
+        _gate_check_lstm(256, "float32"),
+        _gate_check_lstm(256, "bfloat16"),
+        _gate_check_lstm(1280, "float32"),   # tiled kernel
+        _gate_check_lstm(1280, "bfloat16"),
+        _gate_check_gru(256, "float32"),
+        _gate_check_gru(256, "bfloat16"),
+    ]
+    return {"metric": "fused_kernel_numeric_gate", "value": len(checked),
+            "unit": "checks_passed", "checked": checked}
+
+
+def _timed(build, repeats=3, n1=5, n2=45):
+    """Min ms/batch over ``repeats`` slope measurements + spread.
+
+    Min-of-N is the standard noise-robust estimator (cf. timeit): the
+    axon tunnel to the shared chip has multi-x throughput fluctuations,
+    and the minimum is the run least polluted by them; spread_pct
+    documents the observed variance."""
+    from benchmark.harness import chain_slope_ms
+
+    step, carry, fetch = build()
+    times = []
+    for _ in range(repeats):
+        ms, carry = chain_slope_ms(step, carry, fetch, n1=n1, n2=n2)
+        times.append(ms)
+    times.sort()
+    best = times[0]
+    spread = (times[-1] - times[0]) / best * 100.0
+    return best, spread, len(times)
 
 
 def main():
-    from benchmark.harness import build_rnn_step, chain_slope_ms
+    from benchmark.harness import build_image_step, build_rnn_step
 
-    step, carry, fetch = build_rnn_step(batch=64, hidden=256)
-    ms_per_batch, _ = chain_slope_ms(step, carry, fetch, n1=10, n2=110)
+    gate = numeric_gate()
+    print(json.dumps(gate), flush=True)
 
+    # ---- CNN family ------------------------------------------------------
+    ms, spread, reps = _timed(lambda: build_image_step("resnet50", 64))
+    print(json.dumps({
+        "metric": "resnet50_train_samples_per_sec_per_chip_bs64",
+        "value": round(64.0 / ms * 1000.0, 1), "unit": "samples/s",
+        "vs_baseline": round(64.0 / ms * 1000.0 / 2000.0, 3),
+        "repeats": reps, "spread_pct": round(spread, 1)}), flush=True)
+
+    ms, spread, reps = _timed(lambda: build_image_step("alexnet", 128))
+    print(json.dumps({
+        "metric": "alexnet_train_ms_per_batch_bs128",
+        "value": round(ms, 3), "unit": "ms/batch",
+        "vs_baseline": round(334.0 / ms, 3),
+        "repeats": reps, "spread_pct": round(spread, 1)}), flush=True)
+
+    ms, spread, reps = _timed(lambda: build_image_step("googlenet", 128),
+                              n2=25)
+    print(json.dumps({
+        "metric": "googlenet_train_ms_per_batch_bs128",
+        "value": round(ms, 3), "unit": "ms/batch",
+        "vs_baseline": round(1149.0 / ms, 3),
+        "repeats": reps, "spread_pct": round(spread, 1)}), flush=True)
+
+    # ---- large-hidden LSTM (tiled fused kernel) --------------------------
+    ms, spread, reps = _timed(lambda: build_rnn_step(batch=64, hidden=1280),
+                              n2=25)
+    print(json.dumps({
+        "metric": "lstm_text_cls_train_ms_per_batch_bs64_h1280",
+        "value": round(ms, 3), "unit": "ms/batch",
+        "vs_baseline": round(641.0 / ms, 3),
+        "repeats": reps, "spread_pct": round(spread, 1)}), flush=True)
+
+    # ---- DP sharding overhead (8-way virtual CPU mesh) -------------------
+    # This host has ONE core: 8 virtual devices time-multiplex it, so true
+    # scaling efficiency is unmeasurable here (the driver has no multi-chip
+    # hardware). What the virtual mesh CAN measure is whether the sharded
+    # program does the same TOTAL work as the single-device one: value =
+    # t(1 dev) / t(8 dev) at equal global batch on one core — 1.0 means
+    # sharding added no replicated compute; the ICI collectives themselves
+    # are exercised for correctness by the dryrun + tests.
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmark", "scaling.py"),
+             "--model", "smallnet", "--global-batch", "256", "--n1", "2",
+             "--n2", "12"],
+            capture_output=True, text=True, env=env, timeout=1200)
+        line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+        sc = json.loads(line)
+        t1, tn = sc.get("t1_ms"), sc.get("tN_ms")
+        factor = round(t1 / tn, 3) if t1 and tn else None
+        print(json.dumps({
+            "metric": "smallnet_dp8_sharding_overhead_cpu_mesh",
+            "value": factor, "unit": "t1/t8 at equal global batch",
+            "vs_baseline": factor,
+            "note": "single-core host; 1.0 = sharding adds no replicated "
+                    "work (virtual mesh validates program, not hardware)"}),
+            flush=True)
+    except Exception as exc:  # scaling is auxiliary — never sink the bench
+        print(json.dumps({"metric": "smallnet_dp8_sharding_overhead_cpu_mesh",
+                          "value": None, "error": repr(exc)[:200]}),
+              flush=True)
+
+    # ---- flagship LSTM (LAST: the driver's headline line) ----------------
+    ms, spread, reps = _timed(lambda: build_rnn_step(batch=64, hidden=256),
+                              repeats=5, n1=10, n2=110)
     print(json.dumps({
         "metric": "lstm_text_cls_train_ms_per_batch_bs64_h256_seq100",
-        "value": round(ms_per_batch, 3),
-        "unit": "ms/batch",
-        "vs_baseline": round(BASELINE_MS / ms_per_batch, 3),
-    }))
+        "value": round(ms, 3), "unit": "ms/batch",
+        "vs_baseline": round(83.0 / ms, 3),
+        "repeats": reps, "spread_pct": round(spread, 1)}), flush=True)
 
 
 if __name__ == "__main__":

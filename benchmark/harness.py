@@ -18,14 +18,19 @@ import numpy as np
 
 
 def _use_benchmark_precision():
-    """bf16x3-pass matmuls (precision 'high'): near-fp32 accuracy at ~2-4x
-    the MXU throughput of the fp32-emulating 'highest' — the TPU-idiomatic
-    training configuration. An explicit PADDLE_TPU_MATMUL_PRECISION always
-    wins; works regardless of paddle_tpu import order."""
+    """Mixed-precision training policy: bfloat16 forward/backward compute
+    (single-pass MXU matmuls/convs, fp32 accumulation, half the activation
+    HBM traffic) with float32 master params and optimizer — the
+    TPU-idiomatic training configuration (core/dtype.py compute_dtype).
+    Explicit PADDLE_TPU_MATMUL_PRECISION / PADDLE_TPU_COMPUTE_DTYPE env
+    vars win; works regardless of paddle_tpu import order."""
     from paddle_tpu.utils import flags
 
+    if "PADDLE_TPU_COMPUTE_DTYPE" not in os.environ:
+        flags.set_flag("compute_dtype", "bfloat16")
     if "PADDLE_TPU_MATMUL_PRECISION" not in os.environ:
-        flags.set_flag("matmul_precision", "high")
+        # any remaining fp32 matmuls go single-pass too
+        flags.set_flag("matmul_precision", "default")
 
 
 def chain_slope_ms(step, carry, fetch, n1=10, n2=110):

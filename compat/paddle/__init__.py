@@ -1,0 +1,34 @@
+"""`paddle` import-compatibility package.
+
+Lets unmodified reference config files and data providers run against this
+framework: ``from paddle.trainer_config_helpers import *`` (the v1 config
+DSL, reference: python/paddle/trainer_config_helpers/__init__.py),
+``from paddle.trainer.PyDataProvider2 import *`` (the @provider data
+surface, reference: python/paddle/trainer/PyDataProvider2.py:329), and
+``import paddle.v2`` (the v2 API, reference: python/paddle/v2/__init__.py).
+
+This directory is NOT on sys.path by default — `paddle_tpu.cli` prepends
+it when executing a --config file, and users can add
+``<repo>/compat`` themselves to run reference scripts.
+"""
+
+import sys as _sys
+
+import paddle_tpu as _pt
+
+# paddle.v2 IS the paddle_tpu surface (trainer/layer/parameters/... mirror
+# python/paddle/v2); alias the module tree so `import paddle.v2.dataset`
+# style imports resolve.
+_sys.modules.setdefault("paddle.v2", _pt)
+for _name in ("layer", "activation", "attr", "data_type", "pooling",
+              "networks", "optimizer", "parameters", "trainer", "event",
+              "inference", "evaluator", "reader", "minibatch", "dataset",
+              "image"):
+    try:
+        _sys.modules.setdefault("paddle.v2." + _name,
+                                getattr(_pt, _name))
+    except Exception:  # pragma: no cover - optional submodule
+        pass
+
+v2 = _pt
+init = _pt.init

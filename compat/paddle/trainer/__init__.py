@@ -1,0 +1,1 @@
+"""`paddle.trainer` compat namespace (reference: python/paddle/trainer)."""

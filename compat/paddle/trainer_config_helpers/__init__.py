@@ -1,0 +1,177 @@
+"""v1 config-DSL surface (reference: python/paddle/trainer_config_helpers/
+__init__.py — layers.py, networks.py, optimizers.py, attrs.py,
+activations.py, poolings.py, data_sources.py), mapped onto paddle_tpu so
+reference config files run verbatim via ``from paddle.trainer_config_helpers
+import *``.
+
+Naming: the v1 DSL exposes layers as ``*_layer`` (fc_layer, data_layer, …)
+plus helper composites (simple_lstm, …), activations as ``*Activation``
+classes, poolings as ``*Pooling``, optimizers as ``*Optimizer``. All are
+thin aliases of this framework's layer registry — the API surface IS the
+parity deliverable; the implementations are the TPU-native ones.
+"""
+
+from paddle_tpu import activation as _act
+from paddle_tpu import attr as _attr
+from paddle_tpu import layer as _L
+from paddle_tpu import networks as _networks
+from paddle_tpu import optimizer as _opt
+from paddle_tpu import pooling as _pooling
+from paddle_tpu import config as _config
+
+# -- config plane (settings/outputs/data sources/config args) ---------------
+from paddle_tpu.config import (  # noqa: F401
+    AdaDeltaOptimizer,
+    AdaGradOptimizer,
+    AdamOptimizer,
+    AdamaxOptimizer,
+    DecayedAdaGradOptimizer,
+    MomentumOptimizer,
+    RMSPropOptimizer,
+    get_config_arg,
+    outputs,
+    settings,
+    define_py_data_sources2,
+)
+
+from paddle_tpu.optimizer import (  # noqa: F401
+    L1Regularization,
+    L2Regularization,
+    ModelAverage,
+    Regularization,
+)
+
+# -- attrs ------------------------------------------------------------------
+ParamAttr = _attr.ParamAttr
+ParameterAttribute = _attr.ParamAttr
+ExtraAttr = _attr.ExtraAttr
+ExtraLayerAttribute = _attr.ExtraAttr
+
+# -- activations (reference: trainer_config_helpers/activations.py) ---------
+LinearActivation = _act.Linear
+IdentityActivation = _act.Linear
+SigmoidActivation = _act.Sigmoid
+TanhActivation = _act.Tanh
+STanhActivation = _act.STanh
+ReluActivation = _act.Relu
+BReluActivation = _act.BRelu
+SoftReluActivation = _act.SoftRelu
+SoftmaxActivation = _act.Softmax
+SequenceSoftmaxActivation = _act.SequenceSoftmax
+ExpActivation = _act.Exp
+LogActivation = _act.Log
+AbsActivation = _act.Abs
+SquareActivation = _act.Square
+
+# -- poolings ---------------------------------------------------------------
+MaxPooling = _pooling.MaxPooling
+AvgPooling = _pooling.AvgPooling
+SumPooling = _pooling.SumPooling
+SqrtAvgPooling = _pooling.SqrtAvgPooling
+
+
+# -- layers (v1 *_layer names; reference: layers.py __all__ :33) ------------
+def data_layer(name, size, height=None, width=None, **kw):
+    """v1 data_layer: the slot TYPE comes from the @provider registered by
+    define_py_data_sources2 (by name, or declaration order), falling back
+    to a dense vector of ``size`` (reference: config_parser DataLayer +
+    provider input_types contract)."""
+    from paddle_tpu import data_type as _dt
+
+    t = _config.declared_input_type(name)
+    if t is None:
+        t = _dt.dense_vector(size)
+    node = _L.data(name=name, type=t, height=height, width=width)
+    return node
+
+
+fc_layer = _L.fc
+embedding_layer = _L.embedding
+pooling_layer = _L.pooling
+lstmemory = _L.lstmemory
+grumemory = _L.grumemory
+recurrent_layer = _L.recurrent
+concat_layer = _L.concat
+addto_layer = _L.addto
+dropout_layer = _L.dropout
+img_conv_layer = _L.img_conv
+img_pool_layer = _L.img_pool
+batch_norm_layer = _L.batch_norm
+img_cmrnorm_layer = _L.img_cmrnorm
+spp_layer = _L.spp
+maxout_layer = _L.maxout
+pad_layer = _L.pad
+crop_layer = _L.crop
+rotate_layer = _L.rotate
+conv_shift_layer = _L.conv_shift
+bilinear_interp_layer = _L.bilinear_interp
+first_seq = _L.first_seq
+last_seq = _L.last_seq
+expand_layer = _L.expand
+seq_concat_layer = _L.seq_concat
+seq_reshape_layer = _L.seq_reshape
+sub_seq_layer = getattr(_L, "sub_seq", None)
+maxid_layer = _L.max_id
+sampling_id_layer = _L.sampling_id
+eos_layer = _L.eos_id
+classification_cost = _L.classification_cost
+cross_entropy = _L.cross_entropy
+cross_entropy_with_selfnorm = _L.cross_entropy_with_selfnorm
+multi_binary_label_cross_entropy = _L.multi_binary_label_cross_entropy
+square_error_cost = _L.square_error_cost
+regression_cost = _L.square_error_cost
+rank_cost = _L.rank_cost
+lambda_cost = _L.lambda_cost
+huber_cost = _L.huber_classification_cost
+smooth_l1_cost = _L.smooth_l1_cost
+sum_cost = _L.sum_cost
+crf_layer = _L.crf
+crf_decoding_layer = _L.crf_decoding
+ctc_layer = _L.ctc
+warp_ctc_layer = getattr(_L, "warp_ctc", None)
+nce_layer = _L.nce
+hsigmoid_layer = _L.hsigmoid
+mixed_layer = _L.mixed
+trans_layer = _L.trans
+repeat_layer = _L.repeat
+slope_intercept_layer = _L.slope_intercept
+scaling_layer = _L.scaling
+interpolation_layer = _L.interpolation
+power_layer = _L.power
+dotmul_operator = _L.dotmul_operator
+dotmul_projection = _L.dotmul_projection
+full_matrix_projection = _L.full_matrix_projection
+identity_projection = _L.identity_projection
+table_projection = _L.table_projection
+scaling_projection = _L.scaling_projection
+trans_full_matrix_projection = _L.trans_full_matrix_projection
+context_projection = _L.context_projection
+conv_projection = getattr(_L, "conv_projection", None)
+conv_operator = getattr(_L, "conv_operator", None)
+memory = _L.memory
+recurrent_group = _L.recurrent_group
+beam_search = _L.beam_search
+get_output_layer = getattr(_L, "get_output", None)
+cos_sim = _L.cos_sim
+linear_comb_layer = _L.linear_comb
+bias_layer = getattr(_L, "bias", None)
+tensor_layer = _L.tensor
+selective_fc_layer = _L.selective_fc
+block_expand_layer = _L.block_expand
+row_conv_layer = getattr(_L, "row_conv", None)
+print_layer = getattr(_L, "print_layer", None)
+priorbox_layer = getattr(_L, "priorbox", None)
+
+# -- network composites (reference: networks.py) ----------------------------
+from paddle_tpu.networks import (  # noqa: F401
+    bidirectional_lstm,
+    sequence_conv_pool,
+    simple_attention,
+    simple_gru,
+    simple_img_conv_pool,
+    simple_lstm,
+    text_conv_pool,
+)
+
+img_conv_group = getattr(_networks, "img_conv_group", None)
+vgg_16_network = getattr(_networks, "vgg_16_network", None)

@@ -17,6 +17,10 @@ activation_registry = Registry("activation")
 
 class BaseActivation:
     name = None
+    # elementwise activations commute with layout bridges (NHWC<->flat-NCHW)
+    # so image layers can apply them pre-flatten, in the lane-friendly
+    # layout; axis-dependent ones (softmax family) must see the flat layout
+    elementwise = True
 
     def apply(self, x):
         raise NotImplementedError
@@ -101,6 +105,7 @@ class SoftRelu(BaseActivation):
 @_register
 class Softmax(BaseActivation):
     name = "softmax"
+    elementwise = False
 
     def apply(self, x):
         z = x - jnp.max(x, axis=-1, keepdims=True)
@@ -113,6 +118,8 @@ class SequenceSoftmax(BaseActivation):
     """Softmax across the *time* axis of a sequence of scalars
     (ActivationFunction.cpp sequence_softmax). Applied by sequence-aware
     layers which pass (values [B, T], mask [B, T])."""
+
+    elementwise = False
 
     name = "sequence_softmax"
 

@@ -30,11 +30,32 @@ def _load_config(path, config_args=""):
 
     cfgmod.reset()
     cfgmod.set_config_args(config_args)
-    spec = importlib.util.spec_from_file_location("paddle_tpu_user_config",
-                                                  path)
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules["paddle_tpu_user_config"] = mod
-    spec.loader.exec_module(mod)
+    # Reference configs import `paddle.trainer_config_helpers` and sibling
+    # data-provider modules; expose the compat package and the config's own
+    # directory for the duration of the exec only (a config dir's helper
+    # named like a real module must not shadow imports process-wide), like
+    # the reference CLI's embedded config_parser did.
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    added = []
+    for extra in (os.path.join(repo_root, "compat"),
+                  os.path.dirname(os.path.abspath(path))):
+        if os.path.isdir(extra) and extra not in sys.path:
+            sys.path.insert(0, extra)
+            added.append(extra)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "paddle_tpu_user_config", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["paddle_tpu_user_config"] = mod
+        # py2-era configs (the reference is a 2017 codebase) may use xrange
+        mod.xrange = range
+        spec.loader.exec_module(mod)
+    finally:
+        for extra in added:
+            try:
+                sys.path.remove(extra)
+            except ValueError:
+                pass
     # v1-DSL configs (settings()/outputs()/define_py_data_sources2) leave
     # their declarations in the config registry; adapt them onto the
     # cost()/optimizer()/train_reader() surface the commands consume

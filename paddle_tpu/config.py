@@ -24,7 +24,8 @@ def _state():
     global _pending
     if _pending is None:
         _pending = {"settings": {}, "outputs": [], "data_sources": {},
-                    "config_args": {}}
+                    "config_args": {}, "input_types": None,
+                    "data_layer_count": 0}
     return _pending
 
 
@@ -54,17 +55,29 @@ def get_config_arg(name, type_=str, default=None):
 
 
 # -- settings() (trainer_config_helpers/optimizers.py:360) -------------------
-def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
+_UNSET = object()
+
+
+def settings(batch_size=None, learning_rate=_UNSET, learning_method=None,
              regularization=None, gradient_clipping_threshold=None,
-             model_average=None, learning_rate_decay_a=0.0,
-             learning_rate_decay_b=0.0, learning_rate_schedule="constant",
+             model_average=None, learning_rate_decay_a=_UNSET,
+             learning_rate_decay_b=_UNSET, learning_rate_schedule=_UNSET,
              **extra):
     st = _state()
     method = learning_method or _opt.Momentum(momentum=0.0)
-    # re-arm the optimizer's global hyperparameters from settings()
-    method.lr_fn = _opt.make_lr_schedule(
-        learning_rate, learning_rate_decay_a, learning_rate_decay_b,
-        learning_rate_schedule)
+    # Rebuild the lr schedule only when the caller configured it here —
+    # unlike reference v1 optimizers, this framework's optimizers accept
+    # learning_rate directly, and a hybrid settings(learning_method=
+    # Momentum(learning_rate=0.01)) must keep the optimizer's own schedule.
+    lr_args = (learning_rate, learning_rate_decay_a, learning_rate_decay_b,
+               learning_rate_schedule)
+    if any(a is not _UNSET for a in lr_args):
+        method.lr_fn = _opt.make_lr_schedule(
+            1e-3 if learning_rate is _UNSET else learning_rate,
+            0.0 if learning_rate_decay_a is _UNSET else learning_rate_decay_a,
+            0.0 if learning_rate_decay_b is _UNSET else learning_rate_decay_b,
+            "constant" if learning_rate_schedule is _UNSET
+            else learning_rate_schedule)
     if regularization is not None:
         method.regularization = regularization
     if gradient_clipping_threshold is not None:
@@ -102,6 +115,13 @@ def define_py_data_sources2(train_list=None, test_list=None, module=None,
         mod = importlib.import_module(module)
         factory = getattr(mod, obj)
         kwargs = dict(args or {})
+        if getattr(factory, "is_py_data_provider2", False):
+            # @provider-decorated (compat/paddle/trainer/PyDataProvider2):
+            # run the init hook now so data_layer() can bind the slot
+            # types the provider declares (reference: data_layer size must
+            # match the provider's input_types; here the types ARE the
+            # provider's, keyed by name or declaration order)
+            st["input_types"] = factory.make_settings(kwargs).input_types
         if train_list is not None:
             st["data_sources"]["train"] = lambda: factory(train_list,
                                                           **kwargs)
@@ -111,6 +131,21 @@ def define_py_data_sources2(train_list=None, test_list=None, module=None,
         st["data_sources"]["train"] = lambda: train_reader
     if test_reader is not None:
         st["data_sources"]["test"] = lambda: test_reader
+
+
+def declared_input_type(name):
+    """Input type a @provider declared for the next data_layer (compat
+    front end): dict input_types bind by layer name, list input_types by
+    data-layer declaration order. None when no provider is registered."""
+    st = _state()
+    types = st["input_types"]
+    if types is None:
+        return None
+    if isinstance(types, dict):
+        return types.get(name)
+    idx = st["data_layer_count"]
+    st["data_layer_count"] += 1
+    return types[idx] if idx < len(types) else None
 
 
 def pop_config():

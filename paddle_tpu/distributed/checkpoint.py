@@ -144,6 +144,15 @@ def unflatten_state(template, flat, prefix=()):
                 for i, v in enumerate(template)]
     if template is not None and hasattr(template, "shape"):
         key = "/".join(prefix)
+        if key not in flat and prefix[:1] == ("row_step",):
+            # Checkpoints written before sparse mode was enabled (or before
+            # a param gained sparse_update) have no row_step group. Backfill
+            # with the restored GLOBAL step, not zeros: rows must read as
+            # "last touched now", else the lazy L1/L2 catch-up would replay
+            # the whole training history's decay on first touch.
+            step = int(np.asarray(flat.get("step", 0)))
+            return np.full(template.shape, step,
+                           dtype=getattr(template, "dtype", np.int32))
         enforce(key in flat, "checkpoint optimizer state missing %r", key)
         return flat[key]
     return template

@@ -56,6 +56,24 @@ uint32_t crc32(const uint8_t* data, size_t n) {
   return c ^ 0xFFFFFFFFu;
 }
 
+// Headers are fixed little-endian on disk (the Python fallback writes
+// struct '<II'); serialize byte-by-byte so files are interchangeable
+// between the two paths regardless of host endianness.
+bool read_le32(FILE* f, uint32_t* out, size_t* got) {
+  uint8_t b[4];
+  *got = fread(b, 1, 4, f);
+  if (*got != 4) return false;
+  *out = (uint32_t)b[0] | ((uint32_t)b[1] << 8) | ((uint32_t)b[2] << 16) |
+         ((uint32_t)b[3] << 24);
+  return true;
+}
+
+bool write_le32(FILE* f, uint32_t v) {
+  uint8_t b[4] = {(uint8_t)(v & 0xFF), (uint8_t)((v >> 8) & 0xFF),
+                  (uint8_t)((v >> 16) & 0xFF), (uint8_t)((v >> 24) & 0xFF)};
+  return fwrite(b, 1, 4, f) == 4;
+}
+
 struct Writer {
   FILE* f;
 };
@@ -78,9 +96,14 @@ bool read_header(FILE* f, std::string* error) {
 // -1 eof, -2 error, >=0 record length
 long next_record(FILE* f, std::vector<uint8_t>* buf, std::string* error) {
   uint32_t len = 0, crc = 0;
-  size_t got = fread(&len, 1, 4, f);
-  if (got == 0) return -1;  // clean EOF
-  if (got != 4 || fread(&crc, 1, 4, f) != 4) {
+  size_t got = 0;
+  if (!read_le32(f, &len, &got)) {
+    if (got == 0) return -1;  // clean EOF
+    *error = "truncated record header";
+    return -2;
+  }
+  size_t got_crc = 0;
+  if (!read_le32(f, &crc, &got_crc)) {
     *error = "truncated record header";
     return -2;
   }
@@ -179,8 +202,8 @@ int recordio_writer_write(void* w, const uint8_t* data, uint32_t len) {
   Writer* wr = (Writer*)w;
   if (len > (1u << 30)) return -1;  // reader enforces the same cap
   uint32_t crc = crc32(data, len);
-  if (fwrite(&len, 1, 4, wr->f) != 4) return -1;
-  if (fwrite(&crc, 1, 4, wr->f) != 4) return -1;
+  if (!write_le32(wr->f, len)) return -1;
+  if (!write_le32(wr->f, crc)) return -1;
   if (len && fwrite(data, 1, len, wr->f) != len) return -1;
   return 0;
 }
@@ -237,13 +260,14 @@ void* recordio_pool_create(const char** paths, int n_paths, int n_threads,
   return p;
 }
 
-// returns record length, -1 when fully drained, -2 on error
+// returns record length, -1 when fully drained, -2 on error.
+// A shard error is reported only after every healthy reader thread has
+// finished and the ring is drained, so all good records from other shards
+// are delivered deterministically before the IOError surfaces.
 long recordio_pool_next(void* pp) {
   Pool* p = (Pool*)pp;
   std::unique_lock<std::mutex> lk(p->mu);
-  p->can_pop.wait(lk, [&] {
-    return !p->ring.empty() || p->live_readers == 0 || !p->error.empty();
-  });
+  p->can_pop.wait(lk, [&] { return !p->ring.empty() || p->live_readers == 0; });
   if (!p->ring.empty()) {
     p->current = std::move(p->ring.front());
     p->ring.pop_front();

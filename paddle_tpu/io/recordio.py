@@ -88,6 +88,10 @@ class RecordWriter:
             self._f.write(_MAGIC)
 
     def write(self, payload: bytes):
+        # same 1 GiB record cap as the native reader/writer, so a
+        # fallback-written file is always readable by the native path
+        if len(payload) > (1 << 30):
+            raise IOError("record too large on %s (cap 1 GiB)" % self._path)
         if self._lib:
             rc = self._lib.recordio_writer_write(self._h, payload,
                                                  len(payload))
@@ -145,6 +149,8 @@ class RecordReader:
         if len(header) != 8:
             raise IOError("%s: truncated record header" % self._path)
         length, crc = struct.unpack("<II", header)
+        if length > (1 << 30):
+            raise IOError("%s: record too large" % self._path)
         payload = self._f.read(length)
         if len(payload) != length:
             raise IOError("%s: truncated record payload" % self._path)

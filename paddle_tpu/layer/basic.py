@@ -99,17 +99,38 @@ def embedding(input, size, name=None, param_attr=None, layer_attr=None):
 
 @register_layer("concat")
 def concat(input, name=None, act=None, layer_attr=None):
-    """Feature-axis concatenation (reference: ConcatenateLayer)."""
+    """Feature-axis concatenation (reference: ConcatenateLayer).
+
+    When every input is an image (same H, W), the concatenation runs on
+    the NHWC channel/lane axis: the layout bridges cancel with the
+    adjacent conv layers' own bridges and XLA never materializes the
+    spatial-minor form (the flat-NCHW result is bit-identical)."""
     inputs = to_list(input)
     size = sum(i.size for i in inputs)
+    shapes = [getattr(i, "out_img_shape", None) for i in inputs]
+    img_ok = (all(s is not None for s in shapes)
+              and len({s[1:] for s in shapes}) == 1)
 
     def forward(params, values, ctx):
+        from paddle_tpu.activation import to_activation
+        from paddle_tpu.layer.conv import _to_flat, _to_nhwc
+
+        if img_ok and not any(is_seq(v) for v in values):
+            nhwc = [_to_nhwc(data_of(v), *s)
+                    for v, s in zip(values, shapes)]
+            y = jnp.concatenate(nhwc, axis=-1)
+            if getattr(to_activation(act), "elementwise", True):
+                y = finalize(y, act, node.extra_attr, ctx)
+                return _to_flat(y)
+            return finalize(_to_flat(y), act, node.extra_attr, ctx)
         datas = [data_of(v) for v in values]
         out = like(values[0], jnp.concatenate(datas, axis=-1))
         return finalize(out, act, node.extra_attr, ctx)
 
     node = make_node("concat", forward, inputs, name=name, size=size,
                      layer_attr=layer_attr)
+    if img_ok:
+        node.out_img_shape = (sum(s[0] for s in shapes),) + shapes[0][1:]
     return node
 
 
@@ -123,7 +144,22 @@ def addto(input, name=None, act=None, bias_attr=False, layer_attr=None):
     name = name or auto_name("addto_layer")
     bspec = bias_spec(name, (size,), bias_attr)
 
+    shapes = [getattr(i, "out_img_shape", None) for i in inputs]
+    img_ok = (all(s is not None for s in shapes) and len(set(shapes)) == 1)
+
     def forward(params, values, ctx):
+        from paddle_tpu.activation import to_activation
+
+        if (img_ok and bspec is None and not any(is_seq(v) for v in values)
+                and getattr(to_activation(act), "elementwise", True)):
+            # image residual-add (ResNet shortcut) in NHWC — the layout
+            # bridges cancel with the adjacent conv/bn layers' bridges
+            from paddle_tpu.layer.conv import _to_flat, _to_nhwc
+
+            y = _to_nhwc(data_of(values[0]), *shapes[0])
+            for v in values[1:]:
+                y = y + _to_nhwc(data_of(v), *shapes[0])
+            return _to_flat(finalize(y, act, node.extra_attr, ctx))
         out = data_of(values[0])
         for v in values[1:]:
             out = out + data_of(v)
@@ -134,6 +170,8 @@ def addto(input, name=None, act=None, bias_attr=False, layer_attr=None):
     node = make_node("addto", forward, inputs, name=name, size=size,
                      param_specs=[bspec] if bspec else [],
                      layer_attr=layer_attr)
+    if img_ok:
+        node.out_img_shape = shapes[0]
     from paddle_tpu.layer.base import mark_activation
 
     return mark_activation(node, act)

@@ -113,6 +113,8 @@ def img_conv(input, filter_size, num_filters, name=None, num_channels=None,
     bspec = bias_spec(name, bshape, bias_attr)
 
     def forward(params, values, ctx):
+        from paddle_tpu.activation import to_activation
+
         x = _to_nhwc(data_of(values[0]), c, h, w)
         kernel = params[wspec.name]
         if trans:
@@ -123,14 +125,18 @@ def img_conv(input, filter_size, num_filters, name=None, num_channels=None,
             y = conv_ops.conv2d(
                 x, kernel, stride=(sh, sw),
                 padding=((ph, ph), (pw, pw)), groups=groups, dilation=dil)
-        if bspec is not None:
-            if shared_biases:
-                y = y + params[bspec.name]
-                flat = _to_flat(y)
-            else:
-                flat = _to_flat(y) + params[bspec.name]
-        else:
-            flat = _to_flat(y)
+        if bspec is not None and shared_biases:
+            y = y + params[bspec.name]
+        if ((bspec is None or shared_biases)
+                and getattr(to_activation(act), "elementwise", True)):
+            # activation (+dropout) in NHWC: keeps channels on the lane
+            # axis so XLA never materializes activations spatial-minor,
+            # and the flat<->NHWC bridges of adjacent image layers cancel
+            y = finalize(y, act, node.extra_attr, ctx)
+            return like(values[0], _to_flat(y))
+        flat = _to_flat(y)
+        if bspec is not None and not shared_biases:
+            flat = flat + params[bspec.name]
         return finalize(like(values[0], flat), act, node.extra_attr, ctx)
 
     node = make_node("img_conv", forward, [input], name=name,
@@ -221,6 +227,11 @@ def batch_norm(input, name=None, num_channels=None, act=None, bias_attr=None,
                 x, g, b, mm, mv, axes, moving_average_fraction, epsilon)
             ctx.update_state(mean_spec.name, new_mean)
             ctx.update_state(var_spec.name, new_var)
+        from paddle_tpu.activation import to_activation
+
+        if shape and getattr(to_activation(act), "elementwise", True):
+            y = finalize(y, act, node.extra_attr, ctx)  # NHWC, lane-friendly
+            return like(values[0], _to_flat(y))
         out = _to_flat(y) if shape else y
         return finalize(like(values[0], out), act, node.extra_attr, ctx)
 

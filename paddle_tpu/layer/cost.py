@@ -15,6 +15,7 @@ batch-size normalization (TrainerInternal cost accounting).
 import jax.numpy as jnp
 
 from paddle_tpu.activation import Softmax
+from paddle_tpu.core.dtype import upcast_f32
 from paddle_tpu.core.sequence import SequenceBatch
 from paddle_tpu.layer.base import (data_of, is_seq, layer_registry,
                                   make_node, register_layer)
@@ -46,7 +47,7 @@ def cross_entropy(input, label, name=None, weight=None, layer_attr=None):
 
     def forward(params, values, ctx):
         p, y = values[0], values[1]
-        pd, yd = data_of(p), data_of(y)
+        pd, yd = upcast_f32(data_of(p)), data_of(y)
         picked = jnp.take_along_axis(pd, yd[..., None].astype(jnp.int32), axis=-1)[..., 0]
         cost = -jnp.log(picked + _EPS)
         cost = _per_sample(cost, y)
@@ -68,7 +69,7 @@ def classification_cost(input, label, name=None, weight=None, layer_attr=None):
 
     def forward(params, values, ctx):
         logits_in, y = values[0], values[1]
-        x = data_of(logits_in)
+        x = upcast_f32(data_of(logits_in))
         # Softmax-activated input: work from log(p) (subtracting logsumexp of
         # log-probs is an exact no-op, so both branches share one formula
         # conceptually); logits input: standard log-softmax.
@@ -94,7 +95,7 @@ def square_error_cost(input, label, name=None, weight=None, layer_attr=None):
     inputs = [input, label] + ([weight] if weight is not None else [])
 
     def forward(params, values, ctx):
-        x, y = data_of(values[0]), data_of(values[1])
+        x, y = upcast_f32(data_of(values[0])), upcast_f32(data_of(values[1]))
         cost = 0.5 * jnp.sum((x - y) ** 2, axis=-1)
         cost = _per_sample(cost, values[1])
         return _maybe_weight(cost, values, weight is not None)
@@ -113,7 +114,7 @@ def multi_binary_label_cross_entropy(input, label, name=None, layer_attr=None):
     (reference: MultiBinaryLabelCrossEntropy)."""
 
     def forward(params, values, ctx):
-        p, y = data_of(values[0]), data_of(values[1])
+        p, y = upcast_f32(data_of(values[0])), upcast_f32(data_of(values[1]))
         cost = -(y * jnp.log(p + _EPS) + (1.0 - y) * jnp.log(1.0 - p + _EPS))
         return jnp.sum(cost, axis=-1)
 
@@ -189,7 +190,7 @@ def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, name=None,
 @register_layer("huber_regression_cost")
 def huber_regression_cost(input, label, delta=1.0, name=None, layer_attr=None):
     def forward(params, values, ctx):
-        x, y = data_of(values[0]), data_of(values[1])
+        x, y = upcast_f32(data_of(values[0])), upcast_f32(data_of(values[1]))
         a = jnp.abs(x - y)
         cost = jnp.where(a <= delta, 0.5 * a * a, delta * (a - 0.5 * delta))
         return jnp.sum(cost, axis=-1)
@@ -217,7 +218,7 @@ def huber_classification_cost(input, label, name=None, layer_attr=None):
 @register_layer("smooth_l1_cost")
 def smooth_l1_cost(input, label, coeff=1.0, name=None, layer_attr=None):
     def forward(params, values, ctx):
-        x, y = data_of(values[0]), data_of(values[1])
+        x, y = upcast_f32(data_of(values[0])), upcast_f32(data_of(values[1]))
         a = jnp.abs(x - y)
         cost = jnp.where(a < 1.0, 0.5 * a * a, a - 0.5)
         return coeff * jnp.sum(cost, axis=-1)
@@ -247,3 +248,16 @@ def sum_cost(input, name=None, layer_attr=None):
 soft_binary_class_cross_entropy = multi_binary_label_cross_entropy
 layer_registry.register("soft_binary_class_cross_entropy",
                         multi_binary_label_cross_entropy)
+
+
+# Layer types whose non-first inputs are supervision targets (labels,
+# scores, weights) — the mixed-precision policy must NOT quantize those
+# feeds to bfloat16 (topology._run_nodes keeps them float32 so the f32
+# cost math sees full-precision targets).
+COST_LAYER_TYPES = frozenset({
+    "cross_entropy", "classification_cost", "square_error_cost",
+    "multi_binary_label_cross_entropy", "cross_entropy_with_selfnorm",
+    "rank_cost", "lambda_cost", "huber_regression_cost",
+    "huber_classification_cost", "smooth_l1_cost", "sum_cost",
+    "crf", "crf_decoding", "ctc", "warp_ctc",
+})

@@ -120,14 +120,26 @@ class ParallelTransformer:
         # pipelined residual MLP stack (pp)
         enforce(b % self.n_micro == 0,
                 "microbatch count %d must divide batch %d", self.n_micro, b)
+        n_data = self.mesh.shape[self.data_axis]
+        enforce(self.n_micro % n_data == 0,
+                "data axis %d must divide microbatch count %d (each data "
+                "shard pipelines its own microbatches)", n_data, self.n_micro)
         mb = b // self.n_micro
         xs = x.reshape(self.n_micro, mb, l, e)
+        # pin the natural producer sharding (M over dp from the contiguous
+        # batch reshape, sequence over sp) so the pipeline shard_map's
+        # in/out specs match exactly — no involuntary resharding around
+        # the pipelined region in either direction of autodiff
+        xs = jax.lax.with_sharding_constraint(
+            xs, NamedSharding(self.mesh,
+                              P(self.data_axis, None, self.model_axis, None)))
 
         def stage(p, t):
             return t + jnp.tanh(jnp.einsum("mle,ef->mlf", t, p["w"]) + p["b"])
 
         xs = pipeline_apply(stage, params["pipe"], xs, self.mesh,
-                            axis=self.pipe_axis, batch_axis=self.data_axis)
+                            axis=self.pipe_axis, batch_axis=self.data_axis,
+                            seq_axis=self.model_axis)
         x = xs.reshape(b, l, e)
         # mean-pool + tp-sharded classifier head
         pooled = jnp.mean(x, axis=1)

@@ -46,15 +46,18 @@ def img_conv_bn_pool(input, filter_size, num_filters, pool_size, name=None,
 
 def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
                 bias_param_attr=None, inner_param_attr=None, act=None,
-                gate_act=None, state_act=None):
-    """fc (4*size projection) + lstmemory (reference: simple_lstm)."""
+                gate_act=None, state_act=None, mixed_layer_attr=None,
+                lstm_cell_attr=None):
+    """fc (4*size projection) + lstmemory (reference: simple_lstm,
+    trainer_config_helpers/networks.py; mixed_layer_attr/lstm_cell_attr
+    are the v1 ExtraAttrs of the two sub-layers)."""
     proj = L.fc(input=input, size=size * 4, act=None, bias_attr=False,
-                param_attr=mat_param_attr,
+                param_attr=mat_param_attr, layer_attr=mixed_layer_attr,
                 name="%s_transform" % name if name else None)
     return L.lstmemory(input=proj, size=size, reverse=reverse, act=act,
                        gate_act=gate_act, state_act=state_act,
                        bias_attr=bias_param_attr, param_attr=inner_param_attr,
-                       name=name)
+                       layer_attr=lstm_cell_attr, name=name)
 
 
 def bidirectional_lstm(input, size, name=None, return_seq=False,

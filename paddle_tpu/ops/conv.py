@@ -8,6 +8,8 @@ the MXU. Layout is NHWC (TPU-native); the layer wrappers translate from the
 reference's flattened NCHW vector convention at the graph edge.
 """
 
+from functools import partial
+
 import numpy as np
 
 import jax
@@ -57,15 +59,73 @@ def explicit_pad(padding_hw):
 
 def max_pool2d(x_nhwc, window, stride, padding=(0, 0), ceil_mode=True):
     pads = _pool_pads(x_nhwc, window, stride, padding, ceil_mode)
-    # -inf (not finfo.min) keeps reduce_window max differentiable
+    return _max_pool_padded(x_nhwc, tuple(window), tuple(stride),
+                            tuple(pads))
+
+
+def _max_pool_raw(x, window, stride, pads):
+    # -inf (not finfo.min) keeps reduce_window max well-defined under pads
     return lax.reduce_window(
-        x_nhwc,
+        x,
         -jnp.inf,
         lax.max,
         window_dimensions=(1,) + window + (1,),
         window_strides=(1,) + stride + (1,),
         padding=((0, 0),) + pads + ((0, 0),),
     )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _max_pool_padded(x, window, stride, pads):
+    """Max pooling with a hand-written VJP: XLA's native reduce_window-max
+    gradient lowers to select_and_scatter, which serializes windows on TPU
+    (~1ms per pool layer on the CNN benchmarks). The backward here is the
+    Caffe-style equality-compare: scatter dy to every input position that
+    equals its window max — k*k shifted compare/select/adds that XLA fuses
+    into one elementwise kernel. Ties credit every argmax (the reference's
+    CpuMatrix::maxPoolBackward does the same compare, Matrix.cpp)."""
+    return _max_pool_raw(x, window, stride, pads)
+
+
+def _max_pool_vjp_fwd(x, window, stride, pads):
+    out = _max_pool_raw(x, window, stride, pads)
+    return out, (x, out)
+
+
+def _max_pool_vjp_bwd(window, stride, pads, res, dy):
+    x, out = res
+    kh, kw = window
+    sh, sw = stride
+    (pt, _), (pl, _) = pads
+    h, w = x.shape[1], x.shape[2]
+    ninf = jnp.asarray(-jnp.inf, out.dtype)
+    zero = jnp.zeros((), dy.dtype)
+    # dilate outputs onto the padded-input grid: position (oh*sh, ow*sw)
+    # (the window's top-left corner) holds out[oh, ow]
+    cfg_h = (0, kh - 1, sh - 1)
+    cfg_w = (0, kw - 1, sw - 1)
+    dyd = lax.pad(dy, zero, ((0, 0, 0), cfg_h, cfg_w, (0, 0, 0)))
+    outd = lax.pad(out, ninf, ((0, 0, 0), cfg_h, cfg_w, (0, 0, 0)))
+    # generous borders so every shifted window-origin slice stays in range
+    fh, fw = kh - 1, kw - 1
+    bh = max(0, pt + h - dyd.shape[1] + fh)
+    bw = max(0, pl + w - dyd.shape[2] + fw)
+    dyd = jnp.pad(dyd, ((0, 0), (fh, bh), (fw, bw), (0, 0)))
+    outd = jnp.pad(outd, ((0, 0), (fh, bh), (fw, bw), (0, 0)),
+                   constant_values=ninf)
+    dx = jnp.zeros(x.shape, dy.dtype)
+    for di in range(kh):
+        for dj in range(kw):
+            hs, ws = pt - di + fh, pl - dj + fw
+            o = lax.slice(outd, (0, hs, ws, 0),
+                          (outd.shape[0], hs + h, ws + w, outd.shape[3]))
+            d = lax.slice(dyd, (0, hs, ws, 0),
+                          (dyd.shape[0], hs + h, ws + w, dyd.shape[3]))
+            dx = dx + jnp.where(x == o, d, zero)
+    return (dx,)
+
+
+_max_pool_padded.defvjp(_max_pool_vjp_fwd, _max_pool_vjp_bwd)
 
 
 def avg_pool2d(x_nhwc, window, stride, padding=(0, 0), ceil_mode=True,
@@ -111,31 +171,74 @@ def _pool_pads(x, window, stride, padding, ceil_mode):
 def batch_norm_train(x, gamma, beta, moving_mean, moving_var, axes, momentum, eps):
     """Returns (y, new_mean, new_var). ``axes`` are reduce axes (all but the
     channel axis). Reference: BatchNormLayer / CudnnBatchNormLayer with
-    moving_average_fraction (ModelConfig moving_average_fraction)."""
-    mean = jnp.mean(x, axis=axes)
-    var = jnp.var(x, axis=axes)
-    y = gamma * (x - mean) / jnp.sqrt(var + eps) + beta
+    moving_average_fraction (ModelConfig moving_average_fraction).
+
+    Statistics always accumulate in float32 (a bfloat16 mean over a large
+    batch*spatial reduction loses whole digits); the normalized output is
+    cast back to x's dtype so mixed precision flows through."""
+    from paddle_tpu.core.dtype import upcast_f32
+
+    xf = upcast_f32(x)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    inv = jax.lax.rsqrt(var + eps)
+    y = upcast_f32(gamma) * (xf - mean) * inv + upcast_f32(beta)
     new_mean = momentum * moving_mean + (1.0 - momentum) * mean
     new_var = momentum * moving_var + (1.0 - momentum) * var
-    return y, new_mean, new_var
+    return y.astype(x.dtype), new_mean, new_var
 
 
 def batch_norm_infer(x, gamma, beta, moving_mean, moving_var, eps):
-    return gamma * (x - moving_mean) / jnp.sqrt(moving_var + eps) + beta
+    from paddle_tpu.core.dtype import upcast_f32
+
+    xf = upcast_f32(x)
+    y = (upcast_f32(gamma) * (xf - moving_mean)
+         * jax.lax.rsqrt(moving_var + eps) + upcast_f32(beta))
+    return y.astype(x.dtype)
 
 
+def _channel_window_sum(x, size, lo, hi):
+    """Sum over a sliding window on the channel (lane) axis, with explicit
+    asymmetric padding — shared by LRN forward and its transpose."""
+    padded = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (lo, hi)))
+    return sum(padded[..., i: i + x.shape[-1]] for i in range(size))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def cross_map_norm(x_nhwc, size, scale, power):
     """Local response normalization across channels (reference:
     CrossMapNormalOp, paddle/function/CrossMapNormalOp.cpp):
-    out = x / (1 + scale/size * sum_{window} x^2)^power."""
+    out = x / (1 + scale/size * sum_{window} x^2)^power.
+
+    Custom VJP: the analytic LRN gradient
+        dx = dy * base^-p  -  2*(scale/size)*p * x * W^T[dy * x * base^-p-1]
+    is three window-sums, ~2x cheaper than differentiating the padded
+    shifted-slice chain (the AlexNet-bench hot spot)."""
+    alpha = scale / size
+    base = 1.0 + alpha * _channel_window_sum(
+        x_nhwc * x_nhwc, size, size // 2, size - 1 - size // 2)
+    return x_nhwc * base ** (-power)
+
+
+def _cmr_vjp_fwd(x, size, scale, power):
+    alpha = scale / size
+    base = 1.0 + alpha * _channel_window_sum(
+        x * x, size, size // 2, size - 1 - size // 2)
+    return x * base ** (-power), (x, base)
+
+
+def _cmr_vjp_bwd(size, scale, power, res, dy):
+    x, base = res
+    alpha = scale / size
     half = size // 2
-    sq = x_nhwc * x_nhwc
-    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, size - 1 - half)))
-    window = sum(
-        padded[..., i : i + x_nhwc.shape[-1]] for i in range(size)
-    )
-    denom = (1.0 + (scale / size) * window) ** power
-    return x_nhwc / denom
+    t = dy * x * base ** (-power - 1.0)
+    # transpose of the forward window: flipped padding
+    s = _channel_window_sum(t, size, size - 1 - half, half)
+    dx = dy * base ** (-power) - (2.0 * alpha * power) * x * s
+    return (dx,)
+
+
+cross_map_norm.defvjp(_cmr_vjp_fwd, _cmr_vjp_bwd)
 
 
 def spatial_pyramid_pool(x_nhwc, pyramid_height, pool="max"):

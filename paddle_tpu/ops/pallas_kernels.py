@@ -3,20 +3,41 @@
 Replaces the reference's hand-fused CUDA RNN kernels (paddle/cuda/
 hl_cuda_lstm.cu ~700 LoC: one kernel per LSTM step with gate math fused;
 hl_gpu_gru.cuh) with one Pallas kernel per *whole sequence*: the recurrent
-weight and the h/c state live in VMEM for the entire scan, per-step gate
+weight and the h/c state live in VMEM for the scan, per-step gate
 pre-activations stream from HBM, and the small [B,H]x[H,4H] recurrent GEMM
 plus all gate elementwise math fuse into a single program — no per-step
 kernel launches or fusion boundaries (the XLA lax.scan path compiles to a
 while-loop with per-iteration boundaries; this kernel removes them).
 
+Two LSTM variants cover every size (the reference's hl_cuda_lstm.cu handles
+all sizes; round 1 hard-bailed outside 64 <= H <= 512 f32):
+
+* **resident** — w_rec [H, 4H] fits VMEM alongside the streaming blocks;
+  grid (T,), one iteration per timestep.
+* **tiled** — grid (T, NJ): the hidden axis is cut into 128-wide column
+  blocks. LSTM gate math is elementwise per hidden unit, so block j only
+  needs the w_rec columns of gates i,f,g,o restricted to units j*128..;
+  those four strided column groups are pre-gathered into a [NJ, H, 4*128]
+  layout so each block is one contiguous VMEM window. The full [B, H]
+  h-state lives in scratch (double-buffered across j), c-state updates
+  block-diagonally in place.
+
+Mixed precision: blocks stream in the input dtype (bfloat16 under the
+compute_dtype policy — half the HBM traffic, single-pass MXU dots with f32
+accumulation via preferred_element_type); the c state is always f32 scratch.
+
 Training support is a custom VJP whose backward is a second Pallas kernel
 running the reverse scan (gate activations recomputed from the streamed
 pre-activations — one extra GEMM per step instead of materializing 4 gate
-tensors, the standard rematerialization trade).
+tensors, the standard rematerialization trade). Weight gradients are NOT
+accumulated in-kernel: the backward kernel emits per-step dz, and
+dw = einsum(h_prev, dz) runs as one big MXU GEMM outside — avoids
+non-consecutive output-block accumulation (undefined in Pallas) and is
+faster than a per-step rank-B update anyway.
 
-Used automatically by ops.rnn.lstm_scan for the standard
-sigmoid/tanh/no-peephole configuration; anything exotic falls back to the
-lax.scan path. CPU tests run the same kernels with interpret=True.
+Used automatically by ops.rnn.lstm_scan / gru_scan for the standard
+sigmoid/tanh configuration; anything exotic falls back to the lax.scan
+path. CPU tests run the same kernels with interpret=True.
 """
 
 import jax
@@ -34,6 +55,11 @@ except Exception:  # pragma: no cover - environment dependent
     _PALLAS_OK = False
 
 _INTERPRET = False  # flipped by tests on CPU
+
+# VMEM working-set budget (bytes) for kernel-path eligibility; v5e has 16MB
+# more-or-less usable — leave headroom for double buffering.
+_VMEM_BUDGET = 10 * 1024 * 1024
+_BLK = 128  # tiled-path hidden column block (lane width)
 
 
 def available():
@@ -59,49 +85,94 @@ def _sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
-# ---------------------------------------------------------------- forward
+def _dot_precision(dtype):
+    """In-kernel dot precision: f32 inputs honor the framework's
+    matmul_precision flag (so the f32 path is reference-accurate for
+    gradient checks / the bench numeric gate); bf16 inputs are always
+    single-pass MXU."""
+    if dtype == jnp.float32:
+        from paddle_tpu.utils import flags
+
+        name = flags.get_flag("matmul_precision")
+        if name in ("high", "highest"):
+            return getattr(jax.lax.Precision, name.upper())
+    return None
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+def _itemsize(dt):
+    return jnp.dtype(dt).itemsize
+
+
+def lstm_mode(batch, hidden, dtype):
+    """'resident' | 'tiled' | None (fall back to lax.scan).
+
+    Resident covers any 8-aligned hidden whose weights fit VMEM (Mosaic
+    pads odd lane widths — the round-1 coverage, 64 <= H <= 512, and
+    beyond for bf16); the tiled path needs 128-aligned hidden for its
+    column blocks. Anything else falls back to lax.scan."""
+    if _INTERPRET:  # CPU interpret tests: no VMEM/lane constraints
+        return "tiled" if hidden % _BLK == 0 and hidden > _BLK else "resident"
+    if hidden < 8 or hidden % 8 != 0:
+        return None
+    isz = _itemsize(dtype)
+    # resident: w + 2x streamed gate blocks + state scratches + h/c out blocks
+    resident = (hidden * 4 * hidden * isz
+                + 4 * batch * 4 * hidden * isz
+                + 4 * batch * hidden * 4
+                + 4 * batch * hidden * isz)
+    if resident <= _VMEM_BUDGET:
+        return "resident"
+    if hidden % _BLK != 0:
+        return None
+    tiled = (2 * hidden * 4 * _BLK * isz       # w column block, dbl-buffered
+             + 4 * batch * 4 * _BLK * isz      # gate blocks
+             + 3 * batch * hidden * 4          # h x2 + c scratches (f32)
+             + 6 * batch * _BLK * isz)         # h/c out + misc blocks
+    if tiled <= _VMEM_BUDGET:
+        return "tiled"
+    return None
+
+
+# ======================================================================
+# LSTM forward — resident
+# ======================================================================
 
 def _lstm_fwd_kernel(gates_ref, mask_ref, w_ref, h0_ref, c0_ref,
-                     hseq_ref, cseq_ref, hf_ref, cf_ref, h_scr, c_scr):
+                     hseq_ref, cseq_ref, h_scr, c_scr):
     t = pl.program_id(0)
+    dt = hseq_ref.dtype
 
     @pl.when(t == 0)
     def _():
         h_scr[:] = h0_ref[:]
-        c_scr[:] = c0_ref[:]
+        c_scr[:] = _f32(c0_ref[:])
 
     h_prev = h_scr[:]
     c_prev = c_scr[:]
-    z = gates_ref[0] + jnp.dot(h_prev, w_ref[:],
-                               preferred_element_type=jnp.float32)
+    z = _f32(gates_ref[0]) + jnp.dot(h_prev, w_ref[:],
+                                     preferred_element_type=jnp.float32,
+                                     precision=_dot_precision(h_prev.dtype))
     hidden = h_prev.shape[-1]
-    zi = z[:, :hidden]
-    zf = z[:, hidden:2 * hidden]
-    zg = z[:, 2 * hidden:3 * hidden]
-    zo = z[:, 3 * hidden:]
-    i = _sigmoid(zi)
-    f = _sigmoid(zf)
-    g = jnp.tanh(zg)
-    o = _sigmoid(zo)
+    i = _sigmoid(z[:, :hidden])
+    f = _sigmoid(z[:, hidden:2 * hidden])
+    g = jnp.tanh(z[:, 2 * hidden:3 * hidden])
+    o = _sigmoid(z[:, 3 * hidden:])
     c_new = f * c_prev + i * g
     h_new = o * jnp.tanh(c_new)
     m = mask_ref[0]
-    h = jnp.where(m > 0, h_new, h_prev)
+    h = jnp.where(m > 0, h_new.astype(dt), h_prev)
     c = jnp.where(m > 0, c_new, c_prev)
     h_scr[:] = h
     c_scr[:] = c
     hseq_ref[0] = h
-    cseq_ref[0] = c
-
-    @pl.when(t == pl.num_programs(0) - 1)
-    def _():
-        hf_ref[:] = h
-        cf_ref[:] = c
+    cseq_ref[0] = c.astype(dt)
 
 
-def _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0):
-    """gates_tm [T, B, 4H] (input proj + bias), mask_tm [T, B] float,
-    w_rec [H, 4H] -> (h_seq_tm [T, B, H], c_seq_tm, h_f, c_f)."""
+def _lstm_fwd_resident(gates_tm, mask_tm, w_rec, h0, c0):
     t, b, g4 = gates_tm.shape
     hidden = g4 // 4
     dt = gates_tm.dtype
@@ -125,92 +196,194 @@ def _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((b, hidden), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((b, hidden), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((t, b, hidden), dt),
             jax.ShapeDtypeStruct((t, b, hidden), dt),
-            jax.ShapeDtypeStruct((b, hidden), dt),
-            jax.ShapeDtypeStruct((b, hidden), dt),
         ],
         scratch_shapes=[
-            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((b, hidden), dt),
             pltpu.VMEM((b, hidden), jnp.float32),
         ],
         interpret=_interpret(),
     )(gates_tm, mask_tm[..., None], w_rec, h0, c0)
 
 
-# --------------------------------------------------------------- backward
+# ======================================================================
+# LSTM forward — tiled over hidden column blocks
+# ======================================================================
+
+def _gate_blocked(x_g4, hidden):
+    """[..., 4H] -> [..., NJ, 4*BLK]: per hidden block j, the i/f/g/o gate
+    columns for units j*BLK..(j+1)*BLK-1, concatenated."""
+    nj = hidden // _BLK
+    lead = x_g4.shape[:-1]
+    x = x_g4.reshape(lead + (4, nj, _BLK))
+    x = jnp.moveaxis(x, -2, -3)  # [..., NJ, 4, BLK]
+    return x.reshape(lead + (nj, 4 * _BLK))
+
+
+def _gate_unblocked(x_blk, hidden):
+    """Inverse of _gate_blocked: [..., NJ, 4*BLK] -> [..., 4H]."""
+    nj = hidden // _BLK
+    lead = x_blk.shape[:-2]
+    x = x_blk.reshape(lead + (nj, 4, _BLK))
+    x = jnp.moveaxis(x, -3, -2)  # [..., 4, NJ, BLK]
+    return x.reshape(lead + (4 * hidden,))
+
+
+def _lstm_fwd_tiled_kernel(gates_ref, mask_ref, w_ref, h0_ref, c0_ref,
+                           hseq_ref, cseq_ref, hprev_scr, hnext_scr, c_scr):
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    dt = hseq_ref.dtype
+
+    @pl.when((t == 0) & (j == 0))
+    def _():
+        hprev_scr[:] = h0_ref[:]
+        c_scr[:] = _f32(c0_ref[:])
+
+    sl = pl.ds(j * _BLK, _BLK)
+    h_prev_full = hprev_scr[:]
+    z = _f32(gates_ref[0, 0]) + jnp.dot(h_prev_full, w_ref[0],
+                                        preferred_element_type=jnp.float32,
+                                        precision=_dot_precision(h_prev_full.dtype))
+    i = _sigmoid(z[:, :_BLK])
+    f = _sigmoid(z[:, _BLK:2 * _BLK])
+    g = jnp.tanh(z[:, 2 * _BLK:3 * _BLK])
+    o = _sigmoid(z[:, 3 * _BLK:])
+    c_prev = c_scr[:, sl]
+    c_new = f * c_prev + i * g
+    h_new = o * jnp.tanh(c_new)
+    m = mask_ref[0]
+    h = jnp.where(m > 0, h_new.astype(dt), hprev_scr[:, sl])
+    c = jnp.where(m > 0, c_new, c_prev)
+    c_scr[:, sl] = c
+    hnext_scr[:, sl] = h
+    hseq_ref[0] = h
+    cseq_ref[0] = c.astype(dt)
+
+    @pl.when(j == nj - 1)
+    def _():
+        hprev_scr[:] = hnext_scr[:]
+
+
+def _lstm_fwd_tiled(gates_tm, mask_tm, w_rec, h0, c0):
+    t, b, g4 = gates_tm.shape
+    hidden = g4 // 4
+    nj = hidden // _BLK
+    dt = gates_tm.dtype
+    w_blocked = jnp.moveaxis(
+        w_rec.reshape(hidden, 4, nj, _BLK), 2, 0).reshape(nj, hidden, 4 * _BLK)
+    gates_blocked = _gate_blocked(gates_tm, hidden)  # [T, B, NJ, 4BLK]
+    gates_blocked = jnp.moveaxis(gates_blocked, 2, 1)  # [T, NJ, B, 4BLK]
+    return pl.pallas_call(
+        _lstm_fwd_tiled_kernel,
+        grid=(t, nj),
+        in_specs=[
+            pl.BlockSpec((1, 1, b, 4 * _BLK), lambda i, j: (i, j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hidden, 4 * _BLK), lambda i, j: (j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, _BLK), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, _BLK), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, hidden), dt),
+            jax.ShapeDtypeStruct((t, b, hidden), dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hidden), dt),
+            pltpu.VMEM((b, hidden), dt),
+            pltpu.VMEM((b, hidden), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(gates_blocked, mask_tm[..., None], w_blocked, h0, c0)
+
+
+def _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0, mode):
+    if mode == "tiled":
+        return _lstm_fwd_tiled(gates_tm, mask_tm, w_rec, h0, c0)
+    return _lstm_fwd_resident(gates_tm, mask_tm, w_rec, h0, c0)
+
+
+# ======================================================================
+# LSTM backward — resident
+# ======================================================================
 
 def _lstm_bwd_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
                      cseq_ref, dh_seq_ref, dhf_ref, dcf_ref,
-                     dgates_ref, dw_ref, dh0_ref, dc0_ref,
-                     dh_scr, dc_scr):
+                     dgates_ref, dh0_ref, dc0_ref, dh_scr, dc_scr):
     k = pl.program_id(0)          # 0 .. T-1, processing t = T-1-k
+    dt = dgates_ref.dtype
 
     @pl.when(k == 0)
     def _():
-        dh_scr[:] = dhf_ref[:]
-        dc_scr[:] = dcf_ref[:]
-        dw_ref[:] = jnp.zeros_like(dw_ref[:])
+        dh_scr[:] = _f32(dhf_ref[:])
+        dc_scr[:] = _f32(dcf_ref[:])
 
     h_prev = hprev_ref[0]
-    c_prev = cprev_ref[0]
-    z = gates_ref[0] + jnp.dot(h_prev, w_ref[:],
-                               preferred_element_type=jnp.float32)
+    c_prev = _f32(cprev_ref[0])
+    z = _f32(gates_ref[0]) + jnp.dot(h_prev, w_ref[:],
+                                     preferred_element_type=jnp.float32,
+                                     precision=_dot_precision(h_prev.dtype))
     hidden = h_prev.shape[-1]
     i = _sigmoid(z[:, :hidden])
     f = _sigmoid(z[:, hidden:2 * hidden])
     g = jnp.tanh(z[:, 2 * hidden:3 * hidden])
     o = _sigmoid(z[:, 3 * hidden:])
-    tc = jnp.tanh(cseq_ref[0])     # tanh(c_t); masked steps zeroed below
+    tc = jnp.tanh(_f32(cseq_ref[0]))   # tanh(c_t)
 
     m = mask_ref[0]
-    dh_tot = dh_seq_ref[0] + dh_scr[:]
+    dh_tot = _f32(dh_seq_ref[0]) + dh_scr[:]
     dc_tot = dc_scr[:]
     dh_eff = jnp.where(m > 0, dh_tot, 0.0)
     do = dh_eff * tc
     dc_eff = jnp.where(m > 0, dc_tot, 0.0) + dh_eff * o * (1.0 - tc * tc)
-    di = dc_eff * g
-    df = dc_eff * c_prev
-    dg = dc_eff * i
-    dzi = di * i * (1.0 - i)
-    dzf = df * f * (1.0 - f)
-    dzg = dg * (1.0 - g * g)
+    dzi = dc_eff * g * i * (1.0 - i)
+    dzf = dc_eff * c_prev * f * (1.0 - f)
+    dzg = dc_eff * i * (1.0 - g * g)
     dzo = do * o * (1.0 - o)
     dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)
-    dgates_ref[0] = dz
-    dw_ref[:] += jnp.dot(h_prev.T, dz, preferred_element_type=jnp.float32)
+    dgates_ref[0] = dz.astype(dt)
     dh_prev = jnp.where(m > 0, 0.0, dh_tot) + jnp.dot(
-        dz, w_ref[:].T, preferred_element_type=jnp.float32)
+        dz.astype(w_ref.dtype), w_ref[:].T,
+        preferred_element_type=jnp.float32,
+        precision=_dot_precision(w_ref.dtype))
     dc_prev = dc_eff * f + jnp.where(m > 0, 0.0, dc_tot)
     dh_scr[:] = dh_prev
     dc_scr[:] = dc_prev
 
     @pl.when(k == pl.num_programs(0) - 1)
     def _():
-        dh0_ref[:] = dh_prev
-        dc0_ref[:] = dc_prev
+        dh0_ref[:] = dh_prev.astype(dh0_ref.dtype)
+        dc0_ref[:] = dc_prev.astype(dc0_ref.dtype)
 
 
-def _lstm_bwd(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
-              dh_seq_tm, dh_f, dc_f):
+def _lstm_bwd_resident(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
+                       dh_seq_tm, dh_f, dc_f):
     t, b, g4 = gates_tm.shape
     hidden = g4 // 4
     dt = gates_tm.dtype
     rev = lambda i: (t - 1 - i, 0, 0)  # noqa: E731
-    rev2 = lambda i: (t - 1 - i, 0, 0)  # noqa: E731
     fixed = lambda i: (0, 0)           # noqa: E731
     return pl.pallas_call(
         _lstm_bwd_kernel,
         grid=(t,),
         in_specs=[
             pl.BlockSpec((1, b, g4), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, b, 1), rev2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, 1), rev, memory_space=pltpu.VMEM),
             pl.BlockSpec((hidden, g4), fixed, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
@@ -221,13 +394,11 @@ def _lstm_bwd(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
         ],
         out_specs=[
             pl.BlockSpec((1, b, g4), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((hidden, g4), fixed, memory_space=pltpu.VMEM),
             pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
             pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((t, b, g4), dt),
-            jax.ShapeDtypeStruct((hidden, g4), dt),
             jax.ShapeDtypeStruct((b, hidden), dt),
             jax.ShapeDtypeStruct((b, hidden), dt),
         ],
@@ -240,30 +411,363 @@ def _lstm_bwd(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
       dh_seq_tm, dh_f, dc_f)
 
 
-# ------------------------------------------------------------ public VJP
+# ======================================================================
+# LSTM backward — tiled
+# ======================================================================
+
+def _lstm_bwd_tiled_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
+                           cseq_ref, dh_seq_ref, dhf_ref, dcf_ref,
+                           dgates_ref, dh0_ref, dc0_ref,
+                           dhc_scr, dhn_scr, dc_scr):
+    k = pl.program_id(0)
+    j = pl.program_id(1)
+    nt = pl.num_programs(0)
+    nj = pl.num_programs(1)
+    dt = dgates_ref.dtype
+    sl = pl.ds(j * _BLK, _BLK)
+
+    @pl.when((k == 0) & (j == 0))
+    def _():
+        dhc_scr[:] = _f32(dhf_ref[:])
+        dc_scr[:] = _f32(dcf_ref[:])
+
+    h_prev_full = hprev_ref[0]
+    z = _f32(gates_ref[0, 0]) + jnp.dot(h_prev_full, w_ref[0],
+                                        preferred_element_type=jnp.float32,
+                                        precision=_dot_precision(h_prev_full.dtype))
+    i = _sigmoid(z[:, :_BLK])
+    f = _sigmoid(z[:, _BLK:2 * _BLK])
+    g = jnp.tanh(z[:, 2 * _BLK:3 * _BLK])
+    o = _sigmoid(z[:, 3 * _BLK:])
+    tc = jnp.tanh(_f32(cseq_ref[0]))
+    c_prev = _f32(cprev_ref[0])
+
+    m = mask_ref[0]
+    dh_tot = _f32(dh_seq_ref[0]) + dhc_scr[:, sl]
+    dc_tot = dc_scr[:, sl]
+    dh_eff = jnp.where(m > 0, dh_tot, 0.0)
+    do = dh_eff * tc
+    dc_eff = jnp.where(m > 0, dc_tot, 0.0) + dh_eff * o * (1.0 - tc * tc)
+    dzi = dc_eff * g * i * (1.0 - i)
+    dzf = dc_eff * c_prev * f * (1.0 - f)
+    dzg = dc_eff * i * (1.0 - g * g)
+    dzo = do * o * (1.0 - o)
+    dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)
+    dgates_ref[0, 0] = dz.astype(dt)
+
+    # full-width dh contribution from this gate block's dz (dz @ w_j^T has
+    # all H columns); accumulated across j into the next-step carry buffer
+    contrib = jnp.dot(dz.astype(w_ref.dtype), w_ref[0].T,
+                      preferred_element_type=jnp.float32,
+                      precision=_dot_precision(w_ref.dtype))
+
+    @pl.when(j == 0)
+    def _():
+        dhn_scr[:] = contrib
+
+    @pl.when(j > 0)
+    def _():
+        dhn_scr[:] += contrib
+
+    # block-diagonal terms land in this block's columns only: the masked
+    # passthrough of dh, and the dc carry
+    dhn_scr[:, sl] += jnp.where(m > 0, 0.0, dh_tot)
+    dc_scr[:, sl] = dc_eff * f + jnp.where(m > 0, 0.0, dc_tot)
+
+    @pl.when(j == nj - 1)
+    def _():
+        dhc_scr[:] = dhn_scr[:]  # roll the dh carry to step t-1
+
+    @pl.when((k == nt - 1) & (j == nj - 1))
+    def _():
+        dh0_ref[:] = dhc_scr[:].astype(dh0_ref.dtype)
+        dc0_ref[:] = dc_scr[:].astype(dc0_ref.dtype)
+
+
+def _lstm_bwd_tiled(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
+                    dh_seq_tm, dh_f, dc_f):
+    t, b, g4 = gates_tm.shape
+    hidden = g4 // 4
+    nj = hidden // _BLK
+    dt = gates_tm.dtype
+    w_blocked = jnp.moveaxis(
+        w_rec.reshape(hidden, 4, nj, _BLK), 2, 0).reshape(nj, hidden, 4 * _BLK)
+    gates_blocked = jnp.moveaxis(_gate_blocked(gates_tm, hidden), 2, 1)
+    rev4 = lambda k, j: (t - 1 - k, j, 0, 0)   # noqa: E731
+    rev3 = lambda k, j: (t - 1 - k, 0, 0)      # noqa: E731
+    revb = lambda k, j: (t - 1 - k, 0, j)      # noqa: E731
+    fixed = lambda k, j: (0, 0)                # noqa: E731
+    dgates_blocked, dh0, dc0 = pl.pallas_call(
+        _lstm_bwd_tiled_kernel,
+        grid=(t, nj),
+        in_specs=[
+            pl.BlockSpec((1, 1, b, 4 * _BLK), rev4, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, 1), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hidden, 4 * _BLK), lambda k, j: (j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, hidden), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, _BLK), revb, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, _BLK), revb, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, _BLK), revb, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, b, 4 * _BLK), rev4, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, nj, b, 4 * _BLK), dt),
+            jax.ShapeDtypeStruct((b, hidden), dt),
+            jax.ShapeDtypeStruct((b, hidden), dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((b, hidden), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(gates_blocked, mask_tm[..., None], w_blocked, hprev_tm, cprev_tm,
+      cseq_tm, dh_seq_tm, dh_f, dc_f)
+    dgates = _gate_unblocked(jnp.moveaxis(dgates_blocked, 1, 2), hidden)
+    return dgates, dh0, dc0
+
+
+# ======================================================================
+# public LSTM VJP
+# ======================================================================
 
 @jax.custom_vjp
 def lstm_fused(gates_tm, mask_tm, w_rec, h0, c0):
     """Fused masked LSTM scan (standard gates: i,f = sigmoid; g = tanh;
     h = o * tanh(c)). gates_tm [T, B, 4H] already holds W_in·x + b.
-    Returns (h_seq_tm [T, B, H], h_f, c_f)."""
-    h_seq, _, h_f, c_f = _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0)
-    return h_seq, h_f, c_f
+    Returns (h_seq_tm [T, B, H], h_f, c_f). Masked steps copy state
+    forward into the sequence outputs, so h_seq[-1]/c_seq[-1] ARE the
+    final states."""
+    t, b, g4 = gates_tm.shape
+    mode = lstm_mode(b, g4 // 4, gates_tm.dtype) or "resident"
+    h_seq, c_seq = _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0, mode)
+    return h_seq, h_seq[-1], c_seq[-1]
 
 
 def _vjp_fwd(gates_tm, mask_tm, w_rec, h0, c0):
-    h_seq, c_seq, h_f, c_f = _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0)
-    return (h_seq, h_f, c_f), (gates_tm, mask_tm, w_rec, h0, c0, h_seq, c_seq)
+    t, b, g4 = gates_tm.shape
+    mode = lstm_mode(b, g4 // 4, gates_tm.dtype) or "resident"
+    h_seq, c_seq = _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0, mode)
+    return ((h_seq, h_seq[-1], c_seq[-1]),
+            (gates_tm, mask_tm, w_rec, h0, c0, h_seq, c_seq))
 
 
 def _vjp_bwd(res, cotangents):
     gates_tm, mask_tm, w_rec, h0, c0, h_seq, c_seq = res
+    t, b, g4 = gates_tm.shape
+    mode = lstm_mode(b, g4 // 4, gates_tm.dtype) or "resident"
     dh_seq, dh_f, dc_f = cotangents
     hprev_tm = jnp.concatenate([h0[None], h_seq[:-1]], axis=0)
     cprev_tm = jnp.concatenate([c0[None], c_seq[:-1]], axis=0)
-    dgates, dw, dh0, dc0 = _lstm_bwd(gates_tm, mask_tm, w_rec, hprev_tm,
-                                     cprev_tm, c_seq, dh_seq, dh_f, dc_f)
+    bwd = _lstm_bwd_tiled if mode == "tiled" else _lstm_bwd_resident
+    dgates, dh0, dc0 = bwd(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm,
+                           c_seq, dh_seq, dh_f, dc_f)
+    # weight grad as one big MXU GEMM outside the kernel (fp32 accumulation)
+    dw = jnp.einsum("tbh,tbg->hg", hprev_tm, dgates,
+                    preferred_element_type=jnp.float32,
+                    precision=_dot_precision(hprev_tm.dtype)).astype(w_rec.dtype)
     return dgates, None, dw, dh0, dc0
 
 
 lstm_fused.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ======================================================================
+# GRU (resident only; reference hl_gpu_gru.cuh parity)
+# ======================================================================
+
+def gru_mode(batch, hidden, dtype):
+    if _INTERPRET:  # CPU interpret tests
+        return "resident"
+    if hidden < 8 or hidden % 8 != 0:
+        return None
+    isz = _itemsize(dtype)
+    resident = (3 * hidden * hidden * isz       # w_rz + w_c
+                + 4 * batch * 3 * hidden * isz  # proj blocks
+                + 4 * batch * hidden * 4)       # h scratch + blocks
+    return "resident" if resident <= _VMEM_BUDGET else None
+
+
+def _gru_fwd_kernel(proj_ref, mask_ref, wrz_ref, wc_ref, h0_ref,
+                    hseq_ref, h_scr):
+    t = pl.program_id(0)
+    dt = hseq_ref.dtype
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+
+    h_prev = h_scr[:]
+    hidden = h_prev.shape[-1]
+    proj = proj_ref[0]
+    rz = jnp.dot(h_prev, wrz_ref[:], preferred_element_type=jnp.float32,
+                 precision=_dot_precision(h_prev.dtype))
+    u = _sigmoid(_f32(proj[:, :hidden]) + rz[:, :hidden])
+    r = _sigmoid(_f32(proj[:, hidden:2 * hidden]) + rz[:, hidden:])
+    rh = (r * _f32(h_prev)).astype(dt)
+    c = jnp.tanh(_f32(proj[:, 2 * hidden:]) + jnp.dot(
+        rh, wc_ref[:], preferred_element_type=jnp.float32,
+        precision=_dot_precision(rh.dtype)))
+    h_new = u * _f32(h_prev) + (1.0 - u) * c
+    m = mask_ref[0]
+    h = jnp.where(m > 0, h_new.astype(dt), h_prev)
+    h_scr[:] = h
+    hseq_ref[0] = h
+
+
+def _gru_fwd(proj_tm, mask_tm, w_rz, w_c, h0):
+    t, b, g3 = proj_tm.shape
+    hidden = g3 // 3
+    dt = proj_tm.dtype
+    return pl.pallas_call(
+        _gru_fwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, g3), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, 2 * hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((t, b, hidden), dt)],
+        scratch_shapes=[pltpu.VMEM((b, hidden), dt)],
+        interpret=_interpret(),
+    )(proj_tm, mask_tm[..., None], w_rz, w_c, h0)[0]
+
+
+def _gru_bwd_kernel(proj_ref, mask_ref, wrz_ref, wc_ref, hprev_ref,
+                    dh_seq_ref, dhf_ref, dproj_ref, dh0_ref, dh_scr):
+    k = pl.program_id(0)
+    nt = pl.num_programs(0)
+    dt = dproj_ref.dtype
+
+    @pl.when(k == 0)
+    def _():
+        dh_scr[:] = _f32(dhf_ref[:])
+
+    h_prev = hprev_ref[0]
+    hidden = h_prev.shape[-1]
+    h32 = _f32(h_prev)
+    proj = proj_ref[0]
+    rz = jnp.dot(h_prev, wrz_ref[:], preferred_element_type=jnp.float32,
+                 precision=_dot_precision(h_prev.dtype))
+    u = _sigmoid(_f32(proj[:, :hidden]) + rz[:, :hidden])
+    r = _sigmoid(_f32(proj[:, hidden:2 * hidden]) + rz[:, hidden:])
+    rh = (r * h32).astype(dt)
+    c = jnp.tanh(_f32(proj[:, 2 * hidden:]) + jnp.dot(
+        rh, wc_ref[:], preferred_element_type=jnp.float32,
+        precision=_dot_precision(rh.dtype)))
+
+    m = mask_ref[0]
+    dh_tot = _f32(dh_seq_ref[0]) + dh_scr[:]
+    dh_eff = jnp.where(m > 0, dh_tot, 0.0)
+    du = dh_eff * (h32 - c)
+    dc = dh_eff * (1.0 - u)
+    dzc = dc * (1.0 - c * c)
+    drh = jnp.dot(dzc.astype(wc_ref.dtype), wc_ref[:].T,
+                  preferred_element_type=jnp.float32,
+                  precision=_dot_precision(wc_ref.dtype))
+    dr = drh * h32
+    dzu = du * u * (1.0 - u)
+    dzr = dr * r * (1.0 - r)
+    dzrz = jnp.concatenate([dzu, dzr], axis=-1)
+    dh_prev = (dh_eff * u + drh * r
+               + jnp.dot(dzrz.astype(wrz_ref.dtype), wrz_ref[:].T,
+                         preferred_element_type=jnp.float32,
+                         precision=_dot_precision(wrz_ref.dtype))
+               + jnp.where(m > 0, 0.0, dh_tot))
+    dproj_ref[0] = jnp.concatenate([dzu, dzr, dzc], axis=-1).astype(dt)
+    dh_scr[:] = dh_prev
+
+    @pl.when(k == nt - 1)
+    def _():
+        dh0_ref[:] = dh_prev.astype(dh0_ref.dtype)
+
+
+def _gru_bwd(proj_tm, mask_tm, w_rz, w_c, hprev_tm, dh_seq_tm, dh_f):
+    t, b, g3 = proj_tm.shape
+    hidden = g3 // 3
+    dt = proj_tm.dtype
+    rev = lambda i: (t - 1 - i, 0, 0)  # noqa: E731
+    fixed = lambda i: (0, 0)           # noqa: E731
+    return pl.pallas_call(
+        _gru_bwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, g3), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, 1), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, 2 * hidden), fixed,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, hidden), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, g3), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, g3), dt),
+            jax.ShapeDtypeStruct((b, hidden), dt),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, hidden), jnp.float32)],
+        interpret=_interpret(),
+    )(proj_tm, mask_tm[..., None], w_rz, w_c, hprev_tm, dh_seq_tm, dh_f)
+
+
+@jax.custom_vjp
+def gru_fused(proj_tm, mask_tm, w_rz, w_c, h0):
+    """Fused masked GRU scan, reference gate order (hl_gpu_gru.cuh):
+    update u, reset r, candidate c; proj_tm [T, B, 3H] holds W_in·x + b.
+    Returns (h_seq_tm [T, B, H], h_f)."""
+    h_seq = _gru_fwd(proj_tm, mask_tm, w_rz, w_c, h0)
+    return h_seq, h_seq[-1]
+
+
+def _gru_vjp_fwd(proj_tm, mask_tm, w_rz, w_c, h0):
+    h_seq = _gru_fwd(proj_tm, mask_tm, w_rz, w_c, h0)
+    return (h_seq, h_seq[-1]), (proj_tm, mask_tm, w_rz, w_c, h0, h_seq)
+
+
+def _gru_vjp_bwd(res, cotangents):
+    proj_tm, mask_tm, w_rz, w_c, h0, h_seq = res
+    dh_seq, dh_f = cotangents
+    hprev_tm = jnp.concatenate([h0[None], h_seq[:-1]], axis=0)
+    dproj, dh0 = _gru_bwd(proj_tm, mask_tm, w_rz, w_c, hprev_tm,
+                          dh_seq, dh_f)
+    t, b, g3 = proj_tm.shape
+    hidden = g3 // 3
+    # weight grads as big MXU GEMMs outside the kernel; r*h_prev is
+    # recomputed for all t in one batched pass
+    dw_rz = jnp.einsum("tbh,tbg->hg", _f32(hprev_tm),
+                       _f32(dproj[:, :, :2 * hidden]),
+                       precision=_dot_precision(jnp.float32)).astype(w_rz.dtype)
+    # only the reset-gate half of w_rz is needed to recompute r
+    zr = jnp.einsum("tbh,hg->tbg", _f32(hprev_tm), _f32(w_rz[:, hidden:]),
+                    precision=_dot_precision(jnp.float32))
+    r = _sigmoid(_f32(proj_tm[:, :, hidden:2 * hidden]) + zr)
+    rh = r * _f32(hprev_tm)
+    dw_c = jnp.einsum("tbh,tbg->hg", rh,
+                      _f32(dproj[:, :, 2 * hidden:]),
+                      precision=_dot_precision(jnp.float32)).astype(w_c.dtype)
+    return dproj, None, dw_rz, dw_c, dh0
+
+
+gru_fused.defvjp(_gru_vjp_fwd, _gru_vjp_bwd)

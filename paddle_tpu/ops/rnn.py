@@ -129,13 +129,15 @@ def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
                          and (out_act is None or out_act is jnp.tanh))
     from paddle_tpu.ops import pallas_kernels as pk
 
-    # fused-path bounds: w_rec ([H,4H] f32) must fit VMEM alongside the
-    # per-step blocks — H=512 is 4MB of weight; H=1024 (16MB) overflows the
-    # 16MB scoped-vmem budget (measured on v5e). Only the real TPU backend
-    # (or the tests' explicit interpret flag) takes this path — other
-    # backends where pallas merely imports would fail at lowering.
+    # fused-path eligibility (pk.lstm_mode): resident when w_rec fits VMEM
+    # alongside the streaming blocks, hidden-column-tiled otherwise — all
+    # benchmark sizes (H up to 1280+, f32 and bf16) stay fused (reference
+    # hl_cuda_lstm.cu handles all sizes). Only the real TPU backend (or the
+    # tests' explicit interpret flag) takes this path — other backends
+    # where pallas merely imports would fail at lowering.
     if (pk.enabled() and standard_acts and not use_peephole
-            and 64 <= hidden <= 512 and gates_tm.dtype == jnp.float32):
+            and gates_tm.dtype in (jnp.float32, jnp.bfloat16)
+            and pk.lstm_mode(b_, hidden, gates_tm.dtype) is not None):
         h_seq_tm, h_f, c_f = pk.lstm_fused(
             gates_tm, mask_tm.astype(jnp.float32), w_rec, h0, c0)
         ys = h_seq_tm
@@ -155,7 +157,7 @@ def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
 
         sb = SequenceBatch(h_seq, jnp.sum(mask_bt, axis=1).astype(jnp.int32))
         h_seq = sb.reverse().data
-    return h_seq * mask_bt[..., None], (h_f, c_f)
+    return h_seq * mask_bt[..., None].astype(h_seq.dtype), (h_f, c_f)
 
 
 def gru_scan(x_btd, mask_bt, w_in, b, w_rec_rz, w_rec_c, h0=None,
@@ -179,18 +181,29 @@ def gru_scan(x_btd, mask_bt, w_in, b, w_rec_rz, w_rec_c, h0=None,
     proj_tm = jnp.swapaxes(proj, 0, 1)
     mask_tm = jnp.swapaxes(mask_bt, 0, 1)
 
-    def body(carry, xs):
-        p_t, m_t = xs
-        return gru_step(carry, p_t, w_rec_rz, w_rec_c, m_t, gate_act, state_act)
+    from paddle_tpu.ops import pallas_kernels as pk
 
-    h_f, ys = lax.scan(body, h0, (proj_tm, mask_tm))
+    standard = gate_act is jax.nn.sigmoid and state_act is jnp.tanh
+    if (pk.enabled() and standard
+            and proj_tm.dtype in (jnp.float32, jnp.bfloat16)
+            and pk.gru_mode(b_, hidden, proj_tm.dtype) is not None):
+        # fused whole-sequence GRU kernel (hl_gpu_gru.cuh parity)
+        ys, h_f = pk.gru_fused(proj_tm, mask_tm.astype(jnp.float32),
+                               w_rec_rz, w_rec_c, h0)
+    else:
+        def body(carry, xs):
+            p_t, m_t = xs
+            return gru_step(carry, p_t, w_rec_rz, w_rec_c, m_t, gate_act,
+                            state_act)
+
+        h_f, ys = lax.scan(body, h0, (proj_tm, mask_tm))
     h_seq = jnp.swapaxes(ys, 0, 1)
     if reverse:
         from paddle_tpu.core.sequence import SequenceBatch
 
         sb = SequenceBatch(h_seq, jnp.sum(mask_bt, axis=1).astype(jnp.int32))
         h_seq = sb.reverse().data
-    return h_seq * mask_bt[..., None], h_f
+    return h_seq * mask_bt[..., None].astype(h_seq.dtype), h_f
 
 
 def rnn_scan(x_btd, mask_bt, w_rec, h0=None, act=jnp.tanh, reverse=False):
@@ -220,7 +233,7 @@ def rnn_scan(x_btd, mask_bt, w_rec, h0=None, act=jnp.tanh, reverse=False):
 
         sb = SequenceBatch(h_seq, jnp.sum(mask_bt, axis=1).astype(jnp.int32))
         h_seq = sb.reverse().data
-    return h_seq * mask_bt[..., None], h_f
+    return h_seq * mask_bt[..., None].astype(h_seq.dtype), h_f
 
 
 def mdlstm_2d(x_img, w_x, w_h_up, w_h_left, bias, size):

@@ -75,7 +75,10 @@ class Optimizer:
         # sparse-row mode: rows with all-zero gradient are skipped entirely
         # (no slot decay, no regularization) and regularization is caught up
         # lazily when a row is next touched (reference: SparseMomentum
-        # FirstOrderOptimizer.h:40 + ThreadParameterUpdater catchUpWith)
+        # FirstOrderOptimizer.h:40 + ThreadParameterUpdater catchUpWith).
+        # The global flag covers matrix-shaped (ndim>=2) params only — a
+        # dense bias element whose grad is exactly zero must not be frozen;
+        # per-param opt-in is ParamAttr(sparse_update=True).
         self.sparse = bool(sparse)
 
     # slots ------------------------------------------------------------------
@@ -89,8 +92,10 @@ class Optimizer:
         raise NotImplementedError
 
     # full-step --------------------------------------------------------------
-    def _is_sparse_param(self, attr):
-        return self.sparse or bool(getattr(attr, "sparse_update", False))
+    def _is_sparse_param(self, attr, param):
+        if getattr(attr, "sparse_update", False):
+            return True
+        return self.sparse and getattr(param, "ndim", 0) >= 2
 
     def init_state(self, params, param_meta=None):
         param_meta = param_meta or {}
@@ -104,7 +109,7 @@ class Optimizer:
         row_step = {
             k: jnp.zeros((v.shape[0],), jnp.int32)
             for k, v in params.items()
-            if v.ndim >= 1 and self._is_sparse_param(param_meta.get(k))
+            if v.ndim >= 1 and self._is_sparse_param(param_meta.get(k), v)
         }
         if row_step:
             state["row_step"] = row_step

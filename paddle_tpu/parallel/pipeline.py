@@ -27,11 +27,14 @@ except ImportError:  # older jax
 from paddle_tpu.utils.error import enforce
 
 
-def _pipeline_shard(params, xs, stage_fn, axis_name, n_stages, n_micro):
+def _pipeline_shard(params, xs, stage_fn, axis_name, n_stages):
     """Per-shard body. params: this stage's params (leading axis 1, from the
-    'pipe'-sharded stack); xs: [M, mb, ...] microbatches (replicated over
-    the pipe axis). Every device runs every tick (SPMD); `where` masks make
-    only the meaningful results land."""
+    'pipe'-sharded stack); xs: [M_local, mb, ...] microbatches — the
+    microbatch axis may be data-sharded (each data shard pipelines its own
+    microbatches; stages are orthogonal on the pipe axis), so the schedule
+    length comes from the LOCAL shape. Every device runs every tick (SPMD);
+    `where` masks make only the meaningful results land."""
+    n_micro = xs.shape[0]
     p_local = jax.tree_util.tree_map(lambda a: a[0], params)
     idx = jax.lax.axis_index(axis_name)
     fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -53,32 +56,39 @@ def _pipeline_shard(params, xs, stage_fn, axis_name, n_stages, n_micro):
 
 
 def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis="pipe",
-                   batch_axis=None):
+                   batch_axis=None, seq_axis=None):
     """Run ``microbatches`` through ``n_stages`` chained applications of
     ``stage_fn``, stage i's parameters living on pipe-shard i.
 
     - ``stage_fn(params_i, x) -> y`` with ``y.shape == x.shape``.
     - ``stacked_params``: pytree whose leaves have leading axis = n_stages
       (the stage stack), sharded over ``axis``.
-    - ``microbatches``: [M, mb, ...]; optionally ``batch_axis`` names a mesh
-      axis the mb dim (axis 1) is sharded on (composes with dp).
+    - ``microbatches``: [M, mb, ...]; optionally ``batch_axis`` names a
+      mesh axis the MICROBATCH dim (axis 0) is sharded on — that is the
+      natural sharding a data-parallel producer's reshape [B, ...] ->
+      [M, mb, ...] yields (contiguous batch rows land in whole
+      microbatches per data shard), so composing dp costs no reshard.
+      Each data shard pipelines its own microbatches independently.
+    - ``seq_axis``: mesh axis dim 2 (sequence) is sharded on — stage_fn
+      must be elementwise along that dim (true for MLP blocks); keeps
+      sequence-parallel producers/consumers aligned with no reshard.
 
     Returns [M, mb, ...] — equivalent to sequentially applying stage 0..N-1
     to each microbatch.
     """
     enforce(isinstance(mesh, Mesh), "pipeline_apply needs a jax Mesh")
     n_stages = mesh.shape[axis]
-    n_micro = microbatches.shape[0]
     leaves = jax.tree_util.tree_leaves(stacked_params)
     enforce(all(l.shape[0] == n_stages for l in leaves),
             "stacked params leading axis must equal pipe axis size %d",
             n_stages)
     p_spec = jax.tree_util.tree_map(
         lambda l: P(*((axis,) + (None,) * (l.ndim - 1))), stacked_params)
-    x_spec = P(*((None, batch_axis) + (None,) * (microbatches.ndim - 2)))
+    tail = (seq_axis,) + (None,) * (microbatches.ndim - 3) \
+        if microbatches.ndim >= 3 else ()
+    x_spec = P(*((batch_axis, None) + tail))
     body = functools.partial(_pipeline_shard, stage_fn=stage_fn,
-                             axis_name=axis, n_stages=n_stages,
-                             n_micro=n_micro)
+                             axis_name=axis, n_stages=n_stages)
     return shard_map(body, mesh=mesh, in_specs=(p_spec, x_spec),
                      out_specs=x_spec, check_vma=False)(
                          stacked_params, microbatches)

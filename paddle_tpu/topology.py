@@ -41,6 +41,28 @@ class Topology:
                             key=lambda n: n.creation_index)
             if getattr(n, "input_type", None) is not None
         ]
+        # running-state params (BN moving stats) stay float32 under the
+        # mixed-precision policy — their updates bypass the optimizer
+        self._state_param_names = {
+            name for name, spec in self.param_specs().items()
+            if getattr(spec, "is_state", False)
+        }
+        # label-like data layers: consumed ONLY by cost layers at input
+        # position >= 1 (targets/scores/weights). The mixed-precision cast
+        # must not quantize supervision signals — the cost math upcasts to
+        # f32 and should see full-precision targets.
+        from paddle_tpu.layer.cost import COST_LAYER_TYPES
+
+        consumers = {}
+        for node in self.nodes:
+            for pos, parent in enumerate(node.inputs):
+                consumers.setdefault(parent.name, []).append((node, pos))
+        self._label_feed_names = {
+            name for name in self.data_layers
+            if consumers.get(name)
+            and all(n.layer_type in COST_LAYER_TYPES and pos >= 1
+                    for n, pos in consumers[name])
+        }
 
     # -- parameters ---------------------------------------------------------
     def param_specs(self):
@@ -88,6 +110,19 @@ class Topology:
         return {name: values[name] for name in wanted}, ctx.state_updates
 
     def _run_nodes(self, params, feed, ctx):
+        cd = dtype_mod.compute_dtype()
+        if cd is not None:
+            # mixed precision: float32 masters stay outside the trace; the
+            # cast here is the gradient boundary (VJP casts grads back to
+            # float32), so the optimizer update runs in full precision
+            params = {
+                k: (dtype_mod.to_compute(v)
+                    if k not in self._state_param_names else v)
+                for k, v in params.items()
+            }
+            feed = {k: (v if k in self._label_feed_names
+                        else jax.tree.map(dtype_mod.to_compute, v))
+                    for k, v in feed.items()}
         values = {}
         for node in self.nodes:
             try:

@@ -77,6 +77,9 @@ define_flag("show_layer_stat", False, "log per-layer output stats every log_peri
 define_flag("show_parameter_stats_period", 0, "log per-parameter stats every N batches")
 define_flag("default_dtype", "float32", "parameter/activation dtype")
 define_flag("matmul_precision", "highest", "jax matmul precision: default|high|highest")
+define_flag("compute_dtype", "",
+            "mixed-precision forward dtype (bfloat16 = single-pass MXU "
+            "compute with float32 master params); empty = parameter dtype")
 define_flag("enable_x64", False, "enable float64/int64 (cf. WITH_DOUBLE)")
 define_flag("checkgrad_eps", 1e-4, "perturbation for numeric gradient checking")
 define_flag("prefetch_batches", 4, "data-provider background prefetch depth")

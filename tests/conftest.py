@@ -7,21 +7,30 @@ path; bench.py runs on the real chip). Must set XLA flags before jax imports.
 
 import os
 
-
+# Scrub the environment BEFORE importing paddle_tpu (which imports jax):
+# any import-time device touch must already see the CPU platform, never the
+# single-chip axon tunnel (PALLAS_AXON_POOL_IPS), or the whole suite hangs.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+os.environ.setdefault("PADDLE_TPU_LOG_LEVEL", "WARNING")
 
 import sys as _sys
 
 _sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Import pallas BEFORE the backend scrub: registering its tpu lowerings
+# needs the tpu platform to still be known; afterwards interpret-mode
+# kernels run fine on the CPU backend (tests/test_pallas_kernels.py).
+from paddle_tpu.ops import pallas_kernels  # noqa: F401
 
 from paddle_tpu.utils.cpu_mesh import force_cpu_backend
 
 # Deregister non-CPU PJRT backends registered by sitecustomize before this
 # conftest ran, so no test can trigger a (possibly hung) tunnel init.
 force_cpu_backend()
-_flag = "--xla_force_host_platform_device_count=8"
-if _flag not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
-os.environ.setdefault("PADDLE_TPU_LOG_LEVEL", "WARNING")
 
 import sys
 
@@ -34,6 +43,19 @@ import pytest
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(1234)
+
+
+@pytest.fixture(autouse=True)
+def _flag_guard():
+    """Snapshot/restore the global flag registry around every test — e.g.
+    the benchmark harness sets the bf16 mixed-precision policy globally,
+    which must not leak into other tests' gradient-check tolerances."""
+    from paddle_tpu.utils import flags
+
+    snap = flags.all_flags()
+    yield
+    for name, value in snap.items():
+        flags.set_flag(name, value, create=True)
 
 
 @pytest.fixture

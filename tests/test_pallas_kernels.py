@@ -118,3 +118,136 @@ def test_lstmemory_layer_uses_fused_and_matches():
     want, _ = rnn_ops.lstm_scan(gates, sb.mask(jnp.float32), None, None,
                                 params["m.w0"], standard_acts=False)
     np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_tiled_forward_and_grads_match_scan():
+    """H=256 routes to the hidden-column-tiled kernel under interpret mode
+    (pk.lstm_mode); must match lax.scan forward and gradients."""
+    rng = np.random.RandomState(4)
+    b, t, h = 4, 5, 256
+    assert pk.lstm_mode(b, h, jnp.float32) == "tiled"
+    gates = jnp.asarray(rng.randn(b, t, 4 * h) * 0.3, jnp.float32)
+    lengths = np.array([5, 2, 4, 1])
+    mask = jnp.asarray((np.arange(t)[None, :] < lengths[:, None]),
+                       jnp.float32)
+    w = jnp.asarray(rng.randn(h, 4 * h) / np.sqrt(h), jnp.float32)
+    proj = jnp.asarray(rng.randn(b, t, h), jnp.float32)
+    pf = jnp.asarray(rng.randn(b, h), jnp.float32)
+
+    def loss(path, gates, w):
+        h_seq, (h_f, c_f) = path(gates, mask, w)
+        return (jnp.sum(h_seq * proj) + jnp.sum(h_f * pf)
+                + 0.5 * jnp.sum(c_f * pf))
+
+    h_ref, (hf_ref, cf_ref) = _scan_path(gates, mask, w)
+    h_fus, (hf_fus, cf_fus) = _fused_path(gates, mask, w)
+    np.testing.assert_allclose(np.asarray(h_fus), np.asarray(h_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(cf_fus), np.asarray(cf_ref),
+                               rtol=1e-4, atol=1e-4)
+    g_ref = jax.grad(lambda g, w: loss(_scan_path, g, w), argnums=(0, 1))(
+        gates, w)
+    g_fus = jax.grad(lambda g, w: loss(_fused_path, g, w), argnums=(0, 1))(
+        gates, w)
+    np.testing.assert_allclose(np.asarray(g_fus[0]), np.asarray(g_ref[0]),
+                               rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(g_fus[1]), np.asarray(g_ref[1]),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_lstm_fused_bf16_tracks_f32():
+    """bfloat16 inputs (mixed-precision policy) stay on the fused path and
+    track the f32 scan within bf16 tolerance."""
+    gates, mask, w = _inputs(5)
+    h_ref, (hf_ref, cf_ref) = _scan_path(gates, mask, w)
+    h_bf, (hf_bf, cf_bf) = _fused_path(gates.astype(jnp.bfloat16), mask,
+                                       w.astype(jnp.bfloat16))
+    assert h_bf.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(h_bf, np.float32),
+                               np.asarray(h_ref), rtol=0.1, atol=0.05)
+    np.testing.assert_allclose(np.asarray(cf_bf, np.float32),
+                               np.asarray(cf_ref), rtol=0.1, atol=0.08)
+
+
+def _gru_scan_path(proj, mask, w_rz, w_c, fused):
+    import paddle_tpu.ops.pallas_kernels as _pk
+
+    old = _pk.gru_mode
+    if not fused:
+        _pk.gru_mode = lambda *a: None
+    try:
+        return rnn_ops.gru_scan(proj, mask, None, None, w_rz, w_c)
+    finally:
+        _pk.gru_mode = old
+
+
+def test_gru_fused_forward_and_grads_match_scan():
+    rng = np.random.RandomState(6)
+    b, t, h = 4, 6, 64
+    proj = jnp.asarray(rng.randn(b, t, 3 * h) * 0.5, jnp.float32)
+    lengths = np.array([6, 3, 5, 1])
+    mask = jnp.asarray((np.arange(t)[None, :] < lengths[:, None]),
+                       jnp.float32)
+    w_rz = jnp.asarray(rng.randn(h, 2 * h) / np.sqrt(h), jnp.float32)
+    w_c = jnp.asarray(rng.randn(h, h) / np.sqrt(h), jnp.float32)
+    sel = jnp.asarray(rng.randn(b, t, h), jnp.float32)
+    sf = jnp.asarray(rng.randn(b, h), jnp.float32)
+
+    h_ref, hf_ref = _gru_scan_path(proj, mask, w_rz, w_c, fused=False)
+    h_fus, hf_fus = _gru_scan_path(proj, mask, w_rz, w_c, fused=True)
+    np.testing.assert_allclose(np.asarray(h_fus), np.asarray(h_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hf_fus), np.asarray(hf_ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss(fused, proj, w_rz, w_c):
+        h_seq, h_f = _gru_scan_path(proj, mask, w_rz, w_c, fused)
+        return jnp.sum(h_seq * sel) + jnp.sum(h_f * sf)
+
+    g_ref = jax.grad(lambda *a: loss(False, *a), argnums=(0, 1, 2))(
+        proj, w_rz, w_c)
+    g_fus = jax.grad(lambda *a: loss(True, *a), argnums=(0, 1, 2))(
+        proj, w_rz, w_c)
+    for got, want in zip(g_fus, g_ref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_gru_fused_bf16_tracks_f32():
+    """bfloat16 GRU stays on the fused path (mixed-precision policy) and
+    tracks the f32 scan within bf16 tolerance."""
+    rng = np.random.RandomState(8)
+    b, t, h = 4, 6, 64
+    proj = jnp.asarray(rng.randn(b, t, 3 * h) * 0.5, jnp.float32)
+    lengths = np.array([6, 3, 5, 1])
+    mask = jnp.asarray((np.arange(t)[None, :] < lengths[:, None]),
+                       jnp.float32)
+    w_rz = jnp.asarray(rng.randn(h, 2 * h) / np.sqrt(h), jnp.float32)
+    w_c = jnp.asarray(rng.randn(h, h) / np.sqrt(h), jnp.float32)
+    h_ref, hf_ref = _gru_scan_path(proj, mask, w_rz, w_c, fused=False)
+    h_bf, hf_bf = _gru_scan_path(proj.astype(jnp.bfloat16), mask,
+                                 w_rz.astype(jnp.bfloat16),
+                                 w_c.astype(jnp.bfloat16), fused=True)
+    assert h_bf.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(h_bf, np.float32),
+                               np.asarray(h_ref), rtol=0.1, atol=0.06)
+    np.testing.assert_allclose(np.asarray(hf_bf, np.float32),
+                               np.asarray(hf_ref), rtol=0.1, atol=0.06)
+
+    sel = jnp.asarray(rng.randn(b, t, h), jnp.float32)
+
+    def loss(fused, p, wrz, wc):
+        h_seq, h_f = _gru_scan_path(p, mask, wrz, wc, fused)
+        return (jnp.sum(h_seq.astype(jnp.float32) * sel)
+                + jnp.sum(h_f.astype(jnp.float32)))
+
+    g_ref = jax.grad(lambda *a: loss(False, *a), argnums=(0, 1, 2))(
+        proj, w_rz, w_c)
+    g_bf = jax.grad(lambda *a: loss(True, *a), argnums=(0, 1, 2))(
+        proj.astype(jnp.bfloat16), w_rz.astype(jnp.bfloat16),
+        w_c.astype(jnp.bfloat16))
+    for got, want in zip(g_bf, g_ref):
+        got32 = np.asarray(got, np.float32)
+        want32 = np.asarray(want, np.float32)
+        denom = max(1.0, float(np.abs(want32).max()))
+        assert float(np.abs(got32 - want32).max()) / denom < 8e-2
