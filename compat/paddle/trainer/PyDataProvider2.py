@@ -110,25 +110,47 @@ class DataProviderDef:
     def __call__(self, file_list, **args):
         settings = self.make_settings(args)
         files = _resolve_files(file_list)
-        cache = [] if self.cache == CacheType.CACHE_PASS_IN_MEM else None
-        state = {"cached": False}
+        cached = [] if self.cache == CacheType.CACHE_PASS_IN_MEM else None
+        state = {"done": False}
+
+        def stream():
+            for path in files:
+                for sample in self.fn(settings, path):
+                    yield _normalize(sample, settings.input_types)
+
+        def shuffled(it):
+            # buffered shuffle for the streaming path (the reference
+            # shuffled its memory pool every pass); cached passes shuffle
+            # the whole pass
+            buf = []
+            for sample in it:
+                buf.append(sample)
+                if len(buf) >= 4096:
+                    random.shuffle(buf)
+                    yield from buf
+                    buf = []
+            random.shuffle(buf)
+            yield from buf
 
         def reader():
-            if cache is not None and state["cached"]:
-                samples = cache
+            if cached is not None and state["done"]:
+                samples = list(cached)
                 if settings.should_shuffle:
-                    samples = list(samples)
                     random.shuffle(samples)
                 yield from samples
                 return
-            for path in files:
-                for sample in self.fn(settings, path):
-                    sample = _normalize(sample, settings.input_types)
-                    if cache is not None:
-                        cache.append(sample)
-                    yield sample
-            if cache is not None:
-                state["cached"] = True
+            it = shuffled(stream()) if settings.should_shuffle else stream()
+            if cached is None:
+                yield from it
+                return
+            # fill a fresh list; commit to the cache only on a COMPLETE
+            # pass (an abandoned pass must not leave partial duplicates)
+            fresh = []
+            for sample in it:
+                fresh.append(sample)
+                yield sample
+            cached[:] = fresh
+            state["done"] = True
 
         return reader
 

@@ -1,0 +1,83 @@
+"""Real-data convergence demo (VERDICT r1 item 9).
+
+This environment has no network egress, so the true MNIST idx files cannot
+be fetched (paddle_tpu.dataset.common.download implements the fetch+MD5
+contract and will use them the moment they exist in the cache — see
+dataset/mnist.py train()/test()). As the hermetic real-data stand-in this
+demo trains on scikit-learn's BUNDLED handwritten-digits set (the UCI
+test set of 1,797 real 8x8 scans — actual pen-written digits, not
+synthetic), with the same trainer/eval pipeline the MNIST demo uses, and
+must reach >= 97% held-out accuracy (the reference mnist demo's bar).
+
+Run: python demos/mnist/train_real_digits.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def load_readers(test_fraction=0.2, seed=7):
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    x = (digits.data / 8.0 - 1.0).astype(np.float32)   # [N, 64] in [-1, 1]
+    y = digits.target.astype(np.int64)
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(x))
+    x, y = x[order], y[order]
+    n_test = int(len(x) * test_fraction)
+
+    def reader_of(xs, ys):
+        def reader():
+            for img, lab in zip(xs, ys):
+                yield img, int(lab)
+
+        return reader
+
+    return (reader_of(x[n_test:], y[n_test:]),
+            reader_of(x[:n_test], y[:n_test]))
+
+
+def main(num_passes=60, quiet=False):
+    import paddle_tpu as paddle
+    from paddle_tpu import activation as A
+    from paddle_tpu import data_type, layer as L
+
+    paddle.init(use_tpu=os.environ.get("JAX_PLATFORMS") != "cpu")
+    train_reader, test_reader = load_readers()
+
+    img = L.data(name="pixel", type=data_type.dense_vector(64))
+    label = L.data(name="label", type=data_type.integer_value(10))
+    h1 = L.fc(input=img, size=128, act=A.Relu())
+    h2 = L.fc(input=h1, size=64, act=A.Relu())
+    out = L.fc(input=h2, size=10, act=A.Softmax())
+    cost = L.classification_cost(input=out, label=label)
+    err = L.evaluator.classification_error(input=out, label=label,
+                                           name="err") \
+        if hasattr(L, "evaluator") else None
+
+    params = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Adam(learning_rate=1e-3)
+    trainer = paddle.trainer.SGD(cost, params, optimizer)
+    trainer.train(paddle.minibatch.batch(train_reader, 64),
+                  num_passes=num_passes)
+
+    # held-out accuracy
+    inputs = [(x,) for x, _ in test_reader()]
+    labels = np.array([y for _, y in test_reader()])
+    probs = paddle.inference.infer(out, trainer.parameters, inputs)
+    acc = float((np.argmax(probs, axis=1) == labels).mean())
+    if not quiet:
+        print("real-digits held-out accuracy: %.4f (%d test samples)"
+              % (acc, len(labels)))
+    return acc
+
+
+if __name__ == "__main__":
+    accuracy = main()
+    sys.exit(0 if accuracy >= 0.97 else 1)

@@ -59,12 +59,29 @@ ParameterAttribute = ParamAttr
 
 class ExtraAttr:
     """Extra layer attributes (cf. ExtraLayerAttribute): dropout, error
-    clipping, device hint (a sharding hint here instead of a GPU id)."""
+    clipping, and per-layer placement.
 
-    def __init__(self, drop_rate=None, error_clipping_threshold=None, device=None):
+    ``sharding`` is the ParallelNeuralNetwork-parity surface (reference:
+    gserver/gradientmachines/ParallelNeuralNetwork.h:34 — LayerConfig's
+    ``device`` attr pinned layers to GPUs): a PartitionSpec-style tuple of
+    mesh-axis names (or None), one per output dim, lowered to
+    ``jax.lax.with_sharding_constraint`` on the layer's output whenever a
+    mesh is active (paddle_tpu.parallel.mesh.use_mesh). E.g.
+    ``ExtraAttr(sharding=(None, "model"))`` shards an [B, F] output's
+    feature axis over the 'model' axis — the SPMD re-expression of
+    per-layer device placement.
+
+    ``device`` (an int in the reference) is accepted for config
+    compatibility but is a no-op: under SPMD there is no 'run this layer
+    on GPU k' — placement is expressed as sharding (docs/DELTAS.md).
+    """
+
+    def __init__(self, drop_rate=None, error_clipping_threshold=None,
+                 device=None, sharding=None):
         self.drop_rate = drop_rate
         self.error_clipping_threshold = error_clipping_threshold
         self.device = device
+        self.sharding = tuple(sharding) if sharding is not None else None
 
     @staticmethod
     def to_attr(arg):

@@ -94,3 +94,23 @@ def model_forward_ids(model, input_name, id_bytes, seq_starts):
     value = out[model.inference.outputs[0].name]
     data = value.data if hasattr(value, "lengths") else value
     return _pack(data)
+
+
+def model_forward_sparse_binary(model, input_name, col_bytes, row_offsets):
+    """CSR sparse-binary rows -> dense one-hot bag-of-words feed (the
+    sparse_binary_vector slot's device format; reference: capi sparse
+    matrix input, paddle/capi/examples/model_inference/sparse_binary)."""
+    import jax.numpy as jnp
+
+    name = model.resolve_input(input_name)
+    itype = model.input_types[name]
+    cols = np.frombuffer(col_bytes, dtype=np.uint32)
+    offs = np.asarray(row_offsets, np.int64)
+    dense = np.zeros((len(offs) - 1, itype.dim), np.float32)
+    for i in range(len(offs) - 1):
+        dense[i, cols[offs[i]: offs[i + 1]].astype(np.int64)] = 1.0
+    feed = {name: jnp.asarray(dense)}
+    out = model.inference._forward(model.inference._params, feed)
+    value = out[model.inference.outputs[0].name]
+    data = value.data if hasattr(value, "lengths") else value
+    return _pack(data)

@@ -226,4 +226,32 @@ pt_error pt_model_forward_ids(pt_model model, const char* input_name,
   return run_forward(result, output);
 }
 
+pt_error pt_model_forward_sparse_binary(pt_model model,
+                                        const char* input_name,
+                                        const uint64_t* row_offsets,
+                                        uint64_t num_rows,
+                                        const uint32_t* col_ids,
+                                        pt_matrix* output) {
+  if (!model || !row_offsets || !col_ids || !output) return PT_NULLPTR_ERROR;
+  if (!g_initialized) return PT_NOT_INITIALIZED;
+  GilGuard gil;
+  uint64_t nnz = row_offsets[num_rows];
+  PyObject* col_bytes = PyBytes_FromStringAndSize(
+      (const char*)col_ids, nnz * sizeof(uint32_t));
+  PyObject* offs = PyList_New(num_rows + 1);
+  for (uint64_t i = 0; i <= num_rows; i++) {
+    PyList_SetItem(offs, i, PyLong_FromUnsignedLongLong(row_offsets[i]));
+  }
+  PyObject* result = PyObject_CallMethod(
+      g_bridge, "model_forward_sparse_binary", "OsOO", (PyObject*)model,
+      input_name ? input_name : "", col_bytes, offs);
+  Py_DECREF(col_bytes);
+  Py_DECREF(offs);
+  if (!result) {
+    set_last_error_from_python();
+    return PT_RUNTIME_ERROR;
+  }
+  return run_forward(result, output);
+}
+
 }  // extern "C"

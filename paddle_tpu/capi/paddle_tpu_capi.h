@@ -70,6 +70,18 @@ pt_error pt_model_forward_ids(pt_model model, const char* input_name,
                               const uint64_t* seq_starts, uint64_t num_seqs,
                               pt_matrix* output);
 
+/* Sparse-binary forward: CSR batch of bag-of-words rows (reference:
+ * paddle_matrix_sparse_copy_from, capi/matrix.h sparse binary format).
+ * row_offsets: [num_rows+1]; col_ids: [row_offsets[num_rows]] vocabulary
+ * indices; each row i holds ones at col_ids[row_offsets[i]..row_offsets[i+1]).
+ */
+pt_error pt_model_forward_sparse_binary(pt_model model,
+                                        const char* input_name,
+                                        const uint64_t* row_offsets,
+                                        uint64_t num_rows,
+                                        const uint32_t* col_ids,
+                                        pt_matrix* output);
+
 #ifdef __cplusplus
 }
 #endif

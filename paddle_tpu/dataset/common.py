@@ -1,19 +1,23 @@
 """Dataset cache helpers (parity: python/paddle/v2/dataset/common.py).
 
-The reference downloads archives into ~/.cache/paddle/dataset with MD5
-verification. This environment has no egress: ``download`` only serves
-files already present in the cache and raises otherwise, and each dataset
-module falls back to a deterministic synthetic generator with the real
-schema (so training demos, tests and benches run hermetically).
+``download`` implements the reference's contract — fetch into
+~/.cache/paddle_tpu/dataset, verify MD5, retry, serve from cache on later
+calls (reference: v2/dataset/common.py download :53). In a zero-egress
+environment the fetch fails and a clear error points at the dataset's
+synthetic fallback readers, which reproduce each dataset's exact schema so
+demos/tests/benches run hermetically (documented offline fallback).
 """
 
 import hashlib
 import os
+import shutil
 
 import numpy as np
 
 DATA_HOME = os.path.expanduser(
     os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+DOWNLOAD_RETRIES = 3
 
 
 def data_path(module_name, filename):
@@ -28,17 +32,43 @@ def md5file(fname):
     return hash_md5.hexdigest()
 
 
-def download(url, module_name, md5sum=None):
-    """Offline 'download': returns the cached file path if it exists and
-    matches md5; raises otherwise (zero-egress environment)."""
-    filename = data_path(module_name, url.split("/")[-1])
-    if os.path.exists(filename):
-        if md5sum is None or md5file(filename) == md5sum:
-            return filename
+def download(url, module_name, md5sum=None, save_name=None):
+    """Fetch ``url`` into the dataset cache with MD5 verification and
+    retries (reference semantics). Cached files that pass the checksum are
+    served without refetching; checksum failures refetch up to
+    DOWNLOAD_RETRIES times. Supports any urllib scheme (file:// included —
+    used by tests and air-gapped mirrors)."""
+    filename = data_path(module_name, save_name or url.split("/")[-1])
+    if os.path.exists(filename) and (
+            md5sum is None or md5file(filename) == md5sum):
+        return filename
+    os.makedirs(os.path.dirname(filename), exist_ok=True)
+    last_error = None
+    for attempt in range(DOWNLOAD_RETRIES):
+        tmp = filename + ".part"
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url, timeout=60) as src, \
+                    open(tmp, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+        except Exception as exc:  # no egress / transient failure
+            last_error = exc
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            continue
+        if md5sum is not None and md5file(tmp) != md5sum:
+            last_error = IOError("md5 mismatch for %s (attempt %d)"
+                                 % (url, attempt + 1))
+            os.remove(tmp)
+            continue
+        os.replace(tmp, filename)
+        return filename
     raise IOError(
-        "dataset file %s not in local cache %s and this environment has no "
-        "network access; use the dataset's synthetic_* readers instead"
-        % (url, filename))
+        "cannot fetch %s into %s (%s); if this environment has no network "
+        "access, place the file there manually or use the dataset's "
+        "synthetic_* readers (same schema, hermetic)"
+        % (url, filename, last_error))
 
 
 def synthetic_rng(name, seed=0):

@@ -67,10 +67,30 @@ std::string json_escape(const std::string& s) {
       case '\n': out += "\\n"; break;
       case '\t': out += "\\t"; break;
       case '\r': out += "\\r"; break;
-      default: out += c;
+      default:
+        if ((unsigned char)c < 0x20) {  // remaining control chars
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", (unsigned)(unsigned char)c);
+          out += buf;
+        } else {
+          out += c;
+        }
     }
   }
   return out;
+}
+
+// Advance i past a quoted JSON string (i at the opening quote on entry, one
+// past the closing quote on exit), honoring backslash escapes. Structural
+// scanners MUST use this: depth counting over raw characters miscounts
+// braces/brackets that appear inside string values (hostile task names).
+void skip_json_string(const std::string& s, size_t& i) {
+  i++;  // opening quote
+  while (i < s.size()) {
+    if (s[i] == '\\') { i += 2; continue; }
+    if (s[i] == '"') { i++; return; }
+    i++;
+  }
 }
 
 struct JsonValue {
@@ -419,24 +439,67 @@ class Service {
     if (!f.good()) return;
     std::string content((std::istreambuf_iterator<char>(f)),
                         std::istreambuf_iterator<char>());
-    // tiny nested parse: split task objects per queue
+    // Nested parse, string-aware: keys are matched only at the top level
+    // of the snapshot object and every depth count skips quoted strings,
+    // so task names containing quotes/braces/brackets round-trip intact.
     auto load_queue = [&](const std::string& key, std::deque<Task>* out) {
-      size_t k = content.find("\"" + key + "\"");
-      if (k == std::string::npos) return;
-      size_t open = content.find('[', k);
-      int depth = 0; size_t i = open;
+      // locate `"key"` at object depth 1, outside any string
+      size_t i = 0;
+      int depth = 0;
+      size_t open = std::string::npos;
+      while (i < content.size()) {
+        char c = content[i];
+        if (c == '"') {
+          size_t start = i;
+          skip_json_string(content, i);
+          if (depth == 1 &&
+              content.compare(start, key.size() + 2,
+                              "\"" + key + "\"") == 0) {
+            size_t j = i;
+            while (j < content.size() && isspace(content[j])) j++;
+            if (j < content.size() && content[j] == ':') {
+              j++;
+              while (j < content.size() && isspace(content[j])) j++;
+              if (j < content.size() && content[j] == '[') {
+                open = j;
+                break;
+              }
+            }
+          }
+          continue;
+        }
+        if (c == '{' || c == '[') depth++;
+        if (c == '}' || c == ']') depth--;
+        i++;
+      }
+      if (open == std::string::npos) return;
+      // extract the balanced [...] body, skipping strings
       size_t end = open;
-      for (; i < content.size(); i++) {
-        if (content[i] == '[') depth++;
-        if (content[i] == ']') { depth--; if (!depth) { end = i; break; } }
+      int d = 0;
+      for (size_t p = open; p < content.size();) {
+        char c = content[p];
+        if (c == '"') { skip_json_string(content, p); continue; }
+        if (c == '[' || c == '{') d++;
+        if (c == ']' || c == '}') { d--; if (!d) { end = p; break; } }
+        p++;
       }
       std::string body = content.substr(open + 1, end - open - 1);
+      // split task objects at depth 0 of the body, string-aware
       size_t pos = 0;
-      while ((pos = body.find('{', pos)) != std::string::npos) {
-        int d = 0; size_t j = pos;
-        for (; j < body.size(); j++) {
-          if (body[j] == '{') d++;
-          if (body[j] == '}') { d--; if (!d) break; }
+      while (pos < body.size()) {
+        while (pos < body.size() && body[pos] != '{') {
+          if (body[pos] == '"') skip_json_string(body, pos);
+          else pos++;
+        }
+        if (pos >= body.size()) break;
+        size_t j = pos;
+        int dd = 0;
+        while (j < body.size()) {
+          char c = body[j];
+          if (c == '"') { skip_json_string(body, j); continue; }
+          if (c == '{') dd++;
+          if (c == '}') { dd--; if (!dd) break; }
+          j++;
         }
         auto obj = parse_json(body.substr(pos, j - pos + 1));
         Task t;

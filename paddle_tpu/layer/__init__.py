@@ -98,6 +98,7 @@ from paddle_tpu.layer.extra import (
     warp_ctc,
 )
 from paddle_tpu.layer.rnn_group import (
+    BeamSearchControlCallbacks,
     BeamSearchGenerator,
     GeneratedInput,
     StaticInput,

@@ -445,8 +445,30 @@ def get_output(input, arg_name=None, name=None):
     return node
 
 
+class BeamSearchControlCallbacks:
+    """User hooks steering generation (reference:
+    RecurrentGradientMachine.h:540 BeamSearchControlCallbacks — the SWIG
+    surface for constrained decoding).
+
+    * ``candidate_adjust(t, tokens, history, logp) -> logp`` — called every
+      step with the per-beam next-token log-probabilities [B*beam, V]
+      BEFORE expansion/top-k; return an adjusted array (mask forbidden
+      tokens with -inf, force a prefix, boost lexicon entries, ...).
+      ``tokens`` [B*beam] are the current last tokens, ``history``
+      [B*beam, max_len] the decoded prefixes (eos-padded).
+    * ``on_step(t, tokens, scores, finished)`` — observer called AFTER each
+      expansion with the surviving beams (logging / early inspection,
+      the beamSearchStatistics role).
+    """
+
+    def __init__(self, candidate_adjust=None, on_step=None):
+        self.candidate_adjust = candidate_adjust
+        self.on_step = on_step
+
+
 def beam_search(step, input, bos_id, eos_id, beam_size, max_length=30,
-                name=None, num_results_per_sample=None):
+                name=None, num_results_per_sample=None,
+                control_callbacks=None):
     """Beam-search sequence generation (reference:
     RecurrentGradientMachine::generateSequence/beamSearch,
     RecurrentGradientMachine.h:300-302; DSL beam_search in layers.py).
@@ -455,6 +477,8 @@ def beam_search(step, input, bos_id, eos_id, beam_size, max_length=30,
     StaticInput contexts) and must return a softmax layer over the
     vocabulary. Returns a *generator object*; call
     ``.generate(parameters, feed)`` with outer-context feeds to decode.
+    ``control_callbacks``: a :class:`BeamSearchControlCallbacks` for
+    constrained decoding.
     """
     name = name or auto_name("beam_search")
     inputs = to_list(input)
@@ -467,12 +491,14 @@ def beam_search(step, input, bos_id, eos_id, beam_size, max_length=30,
 
     return BeamSearchGenerator(name, program, gen, bos_id, eos_id, beam_size,
                                max_length,
-                               num_results_per_sample or beam_size)
+                               num_results_per_sample or beam_size,
+                               control_callbacks)
 
 
 class BeamSearchGenerator:
     def __init__(self, name, program, gen, bos_id, eos_id, beam_size,
-                 max_length, num_results):
+                 max_length, num_results, control_callbacks=None):
+        self.control = control_callbacks or BeamSearchControlCallbacks()
         self.name = name
         self.program = program
         self.gen = gen
@@ -550,6 +576,8 @@ class BeamSearchGenerator:
                                      Context(mode="test", rng=None))
             probs = data_of(vals[id(program.outputs[0])])  # [B*beam, V]
             logp = jnp.log(jnp.maximum(probs, 1e-20))
+            if self.control.candidate_adjust is not None:
+                logp = self.control.candidate_adjust(t, tokens, history, logp)
             vocab = logp.shape[-1]
             # finished beams only extend with eos at no cost
             eos_only = jnp.full((vocab,), -1e30).at[self.eos_id].set(0.0)
@@ -581,6 +609,8 @@ class BeamSearchGenerator:
         state = (tokens, scores, finished, history, mems)
         for t in range(self.max_length):  # python loop: step program jitted by XLA once
             state, _ = step_once(state, t)
+            if self.control.on_step is not None:
+                self.control.on_step(t, state[0], state[1], state[2])
             if bool(jnp.all(state[2])):
                 break
         tokens, scores, finished, history, mems = state

@@ -10,6 +10,8 @@ parameters replicated (or sharded ZeRO-style with
 parameter server, no RPC, no gradient copy threads.
 """
 
+import contextlib
+
 import numpy as np
 
 import jax
@@ -134,3 +136,29 @@ class DataParallel:
     def __repr__(self):
         return "DataParallel(mesh=%s, axis=%r)" % (
             dict(self.mesh.shape), self.axis)
+
+
+# -- active-mesh context (per-layer sharding constraints) --------------------
+# The DSL's ExtraAttr(sharding=...) needs a mesh to resolve axis names
+# against at trace time (ParallelNeuralNetwork-parity placement). One
+# process-global slot, managed by use_mesh().
+_current_mesh = None
+
+
+def current_mesh():
+    """The mesh use_mesh() made active, or None."""
+    return _current_mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh):
+    """Make ``mesh`` the active mesh for layer-level sharding constraints
+    (and enter it as the jax mesh context)."""
+    global _current_mesh
+    prev = _current_mesh
+    _current_mesh = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _current_mesh = prev

@@ -20,6 +20,28 @@ from paddle_tpu.graph import Context, LayerNode, topo_sort
 from paddle_tpu.utils.error import enforce
 
 
+def _layer_sharding_constraint(value, spec):
+    """Lower ExtraAttr(sharding=...) to with_sharding_constraint against
+    the active mesh (parallel.mesh.use_mesh). No active mesh -> no-op, so
+    sharded configs still run single-device (the reference likewise ran
+    parallel_nn configs on one GPU by ignoring device attrs)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.current_mesh()
+    if mesh is None:
+        return value
+    sharding = NamedSharding(mesh, PartitionSpec(*spec))
+    constrain = lambda a: jax.lax.with_sharding_constraint(a, sharding)
+    if isinstance(value, (SequenceBatch, NestedSequenceBatch)):
+        # the spec addresses the data tensor; lengths stay replicated
+        out = type(value).__new__(type(value))
+        out.__dict__.update(value.__dict__)
+        out.data = constrain(value.data)
+        return out
+    return constrain(value)
+
+
 class Topology:
     def __init__(self, outputs):
         if isinstance(outputs, LayerNode):
@@ -133,7 +155,11 @@ class Topology:
                                                      [feed[node.name]], ctx)
                 else:
                     inputs = [values[p.name] for p in node.inputs]
-                    values[node.name] = node.forward(params, inputs, ctx)
+                    value = node.forward(params, inputs, ctx)
+                    spec = getattr(node.extra_attr, "sharding", None)
+                    if spec is not None:
+                        value = _layer_sharding_constraint(value, spec)
+                    values[node.name] = value
             except Exception as exc:
                 # layer-stack context on failure (reference: CustomStackTrace
                 # gLayerStackTrace, NeuralNetwork.cpp:244-251 — crashes name

@@ -245,3 +245,51 @@ def test_trainer_checkpoint_resume(tmp_path):
     trainer2.train(minibatch.batch(reader, 20), num_passes=1)
     for n in params2.names():
         np.testing.assert_allclose(params2.get(n), params.get(n), rtol=1e-5)
+
+
+def test_snapshot_recovery_hostile_task_names(coordinator, tmp_path):
+    """Wire-format hardening (VERDICT r1 item 10): chunk names containing
+    quotes, backslashes, JSON structure characters, control chars and
+    unicode must survive the snapshot/recover round trip byte-for-byte
+    (reference: go/master service.go snapshot :201 — gob had this for
+    free; the newline-JSON plane must earn it)."""
+    hostile = [
+        'plain.rec',
+        'quo"te.rec',
+        'back\\slash.rec',
+        'brace{curly}.rec',
+        'brack[et].rec',
+        'comma,colon:.rec',
+        'tab\there.rec',
+        'newline\nname.rec',
+        'unicode-é中文.rec',
+        'done',          # collides with a queue key
+        '{"id": 9}',     # looks like a task object
+    ]
+    endpoint, snap, proc = coordinator
+    client = CoordinatorClient(endpoint, worker_id="w0")
+    resp = client.set_dataset(hostile, chunks_per_task=3)
+    assert resp["num_tasks"] == 4
+    t0 = client.get_task()
+    client.task_finished(t0[0])
+    time.sleep(0.5)  # flush the dirty snapshot
+    proc.kill()
+    proc.wait()
+
+    port2, proc2 = spawn_coordinator_on_free_port(snapshot_path=snap)
+    try:
+        c2 = CoordinatorClient("127.0.0.1:%d" % port2, worker_id="w0")
+        status = c2.status()
+        assert status["done"] == 1 and status["todo"] == 3
+        recovered = list(t0[1])
+        cur_pass = status["pass"]
+        while True:
+            task = c2.get_task(pass_id=cur_pass)
+            if task in (None, "retry", "pass_done"):
+                break
+            recovered.extend(task[1])
+            c2.task_finished(task[0])
+        assert sorted(recovered) == sorted(hostile)
+    finally:
+        proc2.kill()
+        proc2.wait()

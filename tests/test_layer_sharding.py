@@ -1,0 +1,81 @@
+"""Per-layer sharding/placement API (VERDICT r1 item 7).
+
+ExtraAttr(sharding=...) is the SPMD re-expression of the reference's
+per-layer device placement (ParallelNeuralNetwork.h:34-63 — LayerConfig
+``device`` pinned layers to GPUs; here a PartitionSpec pins a layer's
+output across mesh axes and XLA inserts the collectives). Alternate fc
+layers are pinned across the 'model' axis of the virtual 8-device mesh;
+outputs must match the unsharded single-device run exactly (lockstep
+test_CompareTwoNets pattern)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import attr, data_type as dt, layer as L
+from paddle_tpu.graph import reset_name_counters
+from paddle_tpu.parallel.mesh import build_mesh, use_mesh
+from paddle_tpu.topology import Topology
+
+
+def _build(with_sharding):
+    reset_name_counters()
+    sh = (lambda *s: attr.ExtraAttr(sharding=s)) if with_sharding else \
+        (lambda *s: None)
+    x = L.data(name="x", type=dt.dense_vector(16))
+    h1 = L.fc(input=x, size=32, name="sh_fc1",
+              layer_attr=sh(None, "model"))     # feature-sharded
+    h2 = L.fc(input=h1, size=32, name="sh_fc2",
+              layer_attr=sh(None, None))        # replicated
+    h3 = L.fc(input=h2, size=32, name="sh_fc3",
+              layer_attr=sh(None, "model"))     # feature-sharded again
+    out = L.fc(input=h3, size=4, name="sh_out")
+    return out
+
+
+def test_alternate_layers_sharded_over_model_axis_match_single_device():
+    out = _build(with_sharding=True)
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    feed = {"x": jnp.asarray(np.random.RandomState(0).randn(8, 16),
+                             jnp.float32)}
+
+    # single-device reference (no active mesh -> constraints are no-ops)
+    ref, _ = topo.apply(params, feed, mode="test")
+
+    mesh = build_mesh({"model": 8})
+    with use_mesh(mesh):
+        got, _ = jax.jit(
+            lambda p, f: topo.apply(p, f, mode="test"))(params, feed)
+    np.testing.assert_allclose(np.asarray(got[out.name]),
+                               np.asarray(ref[out.name]), rtol=2e-5,
+                               atol=1e-6)
+
+
+def test_sharding_constraint_actually_shards():
+    """The constraint is real: inside use_mesh, the pinned layer's value
+    carries the model-axis sharding (not fully replicated)."""
+    out = _build(with_sharding=True)
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(1))
+    feed = {"x": jnp.asarray(np.random.RandomState(1).randn(8, 16),
+                             jnp.float32)}
+    mesh = build_mesh({"model": 8})
+    with use_mesh(mesh):
+        vals, _ = jax.jit(
+            lambda p, f: topo.apply_all(p, f, mode="test"))(params, feed)
+    sharded = vals["sh_fc1"]
+    assert "model" in str(sharded.sharding.spec), sharded.sharding
+
+
+def test_v1_device_attr_accepted_as_noop():
+    """Reference configs carrying ExtraAttr(device=k) still build and run
+    (placement-by-gpu-id is a documented SPMD delta)."""
+    reset_name_counters()
+    x = L.data(name="x", type=dt.dense_vector(4))
+    out = L.fc(input=x, size=2, layer_attr=attr.ExtraAttr(device=1))
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    vals, _ = topo.apply(params, {"x": jnp.ones((2, 4))}, mode="test")
+    assert np.isfinite(np.asarray(vals[out.name])).all()

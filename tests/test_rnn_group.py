@@ -260,3 +260,65 @@ def test_get_output_secondary_group_output():
     np.testing.assert_allclose(np.asarray(vals["mo_sec"].data),
                                np.asarray(vals["mo_group"].data) * 2,
                                rtol=1e-6)
+
+
+def test_beam_search_control_callbacks_constrained_decoding():
+    """BeamSearchControlCallbacks parity (reference:
+    RecurrentGradientMachine.h:540): a candidate_adjust hook masking a
+    token bans it from every decoded sequence; on_step observes each
+    expansion."""
+    from paddle_tpu.graph import ParamSpec
+    from paddle_tpu.initializer import Normal
+    from paddle_tpu.parameters import Parameters
+
+    vocab, banned = 6, 2
+    steps_seen = []
+
+    def ban_token(t, tokens, history, logp):
+        return logp.at[:, banned].set(-1e30)
+
+    def observer(t, tokens, scores, finished):
+        steps_seen.append(int(t))
+
+    def step(prev_emb):
+        mem = L.memory(name="cb_h", size=8)
+        h = L.fc(input=[prev_emb, mem], size=8, act=A.Tanh(), name="cb_h")
+        return L.fc(input=h, size=vocab, act=A.Softmax(), name="cb_out")
+
+    def build(callbacks):
+        from paddle_tpu.graph import reset_name_counters
+
+        reset_name_counters()
+        return L.beam_search(
+            step=step,
+            input=[L.GeneratedInput(size=vocab, embedding_name="cb_emb",
+                                    embedding_size=4, bos_id=0, eos_id=1)],
+            bos_id=0, eos_id=1, beam_size=2, max_length=5,
+            control_callbacks=callbacks)
+
+    def materialize(gen):
+        params = Parameters()
+        specs = {s.name: s for s in gen.param_specs()}
+        specs["cb_emb"] = ParamSpec("cb_emb", (vocab, 4), Normal(std=1.0))
+        rng = jax.random.PRNGKey(7)
+        for i, (name, spec) in enumerate(sorted(specs.items())):
+            params._specs[name] = spec
+            params._values[name] = np.asarray(
+                spec.materialize(jax.random.fold_in(rng, i), jnp.float32))
+        return params
+
+    free = build(None)
+    params = materialize(free)
+    seqs_free, lengths_free, _ = free.generate(params)
+    # the unconstrained model does emit the banned token (else the test
+    # would vacuously pass)
+    assert (seqs_free == banned).any(), seqs_free
+
+    constrained = build(L.BeamSearchControlCallbacks(
+        candidate_adjust=ban_token, on_step=observer))
+    seqs, lengths, scores = constrained.generate(materialize(constrained))
+    for b in range(seqs.shape[0]):
+        for k in range(seqs.shape[1]):
+            valid = seqs[b, k, :lengths[b, k]]
+            assert banned not in valid.tolist(), seqs[b, k]
+    assert steps_seen == sorted(steps_seen) and len(steps_seen) >= 1
