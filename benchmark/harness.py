@@ -63,9 +63,16 @@ def _train_step_harness(topo, cost_name, optimizer, feed_of, data,
     import jax
     import jax.numpy as jnp
 
+    from paddle_tpu.optimizer import ParamPool
+
+    params = topo.init_params(jax.random.PRNGKey(0))
+    pool = ParamPool(params)
+    use_pool = pool.enabled() and ParamPool.compatible_with(optimizer)
+
     def train_step(params, opt_state, *data):
         def loss_fn(p):
-            values, _ = topo.apply(p, feed_of(*data), mode="test")
+            full = pool.expand(p) if use_pool else p
+            values, _ = topo.apply(full, feed_of(*data), mode="test")
             return jnp.mean(values[cost_name])
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -73,7 +80,10 @@ def _train_step_harness(topo, cost_name, optimizer, feed_of, data,
         return loss, new_params, new_state
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
-    params = topo.init_params(jax.random.PRNGKey(0))
+    if use_pool:
+        # flat master-parameter pool: one fused optimizer update instead
+        # of hundreds of tiny per-buffer kernels (ParamPool docstring)
+        params = pool.compress(params)
     opt_state = optimizer.init_state(params)
     loss0 = jnp.zeros(())
     if dp_mesh is not None:

@@ -58,9 +58,21 @@ def explicit_pad(padding_hw):
 
 
 def max_pool2d(x_nhwc, window, stride, padding=(0, 0), ceil_mode=True):
+    """Max pooling. The gradient defaults to XLA's native
+    reduce_window/select_and_scatter path: once activations stay in NHWC
+    (channels on lanes), it beats the Caffe-style equality-compare VJP by
+    ~2x on large feature maps (measured on v5e: GoogleNet bwd 18 vs 32
+    ms/step, AlexNet 11 vs 14). The equality VJP below is kept behind
+    PADDLE_TPU_EQUALITY_POOL_GRAD for shapes where windows are large
+    relative to stride (its cost scales with k*k reads of the input grid,
+    select_and_scatter's with window serialization)."""
+    import os
+
     pads = _pool_pads(x_nhwc, window, stride, padding, ceil_mode)
-    return _max_pool_padded(x_nhwc, tuple(window), tuple(stride),
-                            tuple(pads))
+    if os.environ.get("PADDLE_TPU_EQUALITY_POOL_GRAD"):
+        return _max_pool_padded(x_nhwc, tuple(window), tuple(stride),
+                                tuple(pads))
+    return _max_pool_raw(x_nhwc, tuple(window), tuple(stride), tuple(pads))
 
 
 def _max_pool_raw(x, window, stride, pads):

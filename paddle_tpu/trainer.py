@@ -68,6 +68,19 @@ class SGD:
         optimizer = self.optimizer
         param_meta = self._param_meta
 
+        # flat master-parameter pool: uniform trainables ride the train
+        # step as ONE array (single fused optimizer update instead of
+        # hundreds of tiny per-buffer kernels — optimizer.ParamPool)
+        from paddle_tpu.optimizer import ParamPool
+
+        host = self.parameters.as_dict()
+        pool = ParamPool({n: host[n] for n in trainable_names},
+                         self._param_meta)
+        self._pool = pool if (pool.enabled()
+                              and ParamPool.compatible_with(optimizer)) \
+            else None
+        use_pool = self._pool is not None
+
         def split(params):
             t = {n: params[n] for n in trainable_names}
             s = {n: params[n] for n in static_names}
@@ -87,7 +100,8 @@ class SGD:
 
         def train_step(trainable, static, state, opt_state, feed, rng):
             def loss_fn(tr):
-                params = {**tr, **static, **state}
+                full = pool.expand(tr) if use_pool else tr
+                params = {**full, **static, **state}
                 cost_total, values, updates, eval_stats = forward_all(
                     params, feed, "train", rng)
                 return cost_total, (updates, eval_stats)
@@ -100,7 +114,8 @@ class SGD:
             return loss, new_trainable, new_state, new_opt_state, eval_stats
 
         def eval_step(trainable, static, state, feed):
-            params = {**trainable, **static, **state}
+            full = pool.expand(trainable) if use_pool else trainable
+            params = {**full, **static, **state}
             cost_total, values, _, eval_stats = forward_all(
                 params, feed, "test", None)
             outs = {o.name: values[o.name] for o in self.extra_outputs}
@@ -219,7 +234,7 @@ class SGD:
         debug line, computed from a plain forward on the current batch."""
         from paddle_tpu.layer.base import data_of
 
-        params = {**self._trainable, **self._static, **self._state}
+        params = {**self._expanded_trainable(), **self._static, **self._state}
         values, _ = self.topology.apply_all(params, feed, mode="test")
         for name, val in values.items():
             arr = np.asarray(jax.device_get(data_of(val)))
@@ -229,7 +244,7 @@ class SGD:
                         arr.mean(), np.abs(arr).mean(), arr.max())
 
     def _log_param_stats(self):
-        for name, val in self._trainable.items():
+        for name, val in self._expanded_trainable().items():
             arr = np.asarray(jax.device_get(val))
             logger.info("param %s: avg_abs=%.6g max_abs=%.6g", name,
                         np.abs(arr).mean(), np.abs(arr).max())
@@ -241,13 +256,21 @@ class SGD:
         checkpoint restore both go through here)."""
         t, s, st = self._split(self.parameters.as_dict())
         self._trainable = {k: jnp.asarray(v) for k, v in t.items()}
+        if getattr(self, "_pool", None) is not None:
+            self._trainable = self._pool.compress(self._trainable)
         self._static = {k: jnp.asarray(v) for k, v in s.items()}
         self._state = {k: jnp.asarray(v) for k, v in st.items()}
+
+    def _expanded_trainable(self):
+        """Per-name view of the (possibly pooled) trainable carry."""
+        if getattr(self, "_pool", None) is not None:
+            return self._pool.expand(self._trainable)
+        return self._trainable
 
     def _sync_back(self):
         """Copy device training state back into the Parameters object so
         save/inspect sees current values (v2's gm<->parameters append)."""
-        host = jax.device_get({**self._trainable, **self._state})
+        host = jax.device_get({**self._expanded_trainable(), **self._state})
         self.parameters.update_from(host)
 
     def save_parameter_to_tar(self, f):
@@ -265,8 +288,13 @@ class SGD:
         if coordinator is not None and not coordinator.request_save_model():
             return None
         self._sync_back()
+        # the checkpoint wire format stays per-parameter (round-1
+        # compatible): pooled optimizer slots are split back by name
+        opt_state = self._opt_state
+        if getattr(self, "_pool", None) is not None:
+            opt_state = self._pool.unpool_state(jax.device_get(opt_state))
         return ckpt.save_checkpoint(
-            directory, self.parameters, opt_state=jax.device_get(self._opt_state),
+            directory, self.parameters, opt_state=jax.device_get(opt_state),
             step=self._step_count, pass_id=pass_id, keep=keep)
 
     def restore_checkpoint(self, directory_or_path):
@@ -302,11 +330,14 @@ class SGD:
                 "model, skipped: %s", len(skipped), skipped[:8])
         self._materialize_device_state()
         if opt_flat is not None:
-            template = self.optimizer.init_state(self._trainable,
+            # per-name template (the wire format), then re-pool if pooled
+            template = self.optimizer.init_state(self._expanded_trainable(),
                                                  self._param_meta)
+            restored_state = ckpt.unflatten_state(template, opt_flat)
+            if getattr(self, "_pool", None) is not None:
+                restored_state = self._pool.pool_state(restored_state)
             self._opt_state = jax.tree_util.tree_map(
-                jnp.asarray,
-                ckpt.unflatten_state(template, opt_flat))
+                jnp.asarray, restored_state)
         self._step_count = int(meta.get("step", 0))
         return meta
 

@@ -413,3 +413,29 @@ def test_reference_layer_name_aliases():
                      "blockexpand", "gated_recurrent", "warp_ctc",
                      "mdlstmemory"):
         assert ref_name in layer_registry._entries, ref_name
+
+
+def test_equality_pool_grad_matches_native():
+    """The opt-in Caffe-style equality max-pool VJP (ops/conv.py
+    _max_pool_padded) must produce the same gradients as XLA's native
+    select_and_scatter path on non-tied data (ties differ by convention:
+    equality credits every argmax, select_and_scatter the first)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import conv as conv_ops
+
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(2, 9, 9, 3), jnp.float32)
+    window, stride, pads = (3, 3), (2, 2), ((0, 1), (0, 1))
+
+    def loss_custom(x):
+        return jnp.sum(conv_ops._max_pool_padded(x, window, stride, pads) ** 2)
+
+    def loss_native(x):
+        return jnp.sum(conv_ops._max_pool_raw(x, window, stride, pads) ** 2)
+
+    g_c = jax.grad(loss_custom)(x)
+    g_n = jax.grad(loss_native)(x)
+    np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_n),
+                               rtol=1e-5, atol=1e-6)
