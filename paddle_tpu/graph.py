@@ -136,6 +136,7 @@ class LayerNode:
         self.param_specs = list(param_specs)
         self.extra_attr = extra_attr or ExtraAttr()
         self.seq_level = seq_level  # None=unknown, 0=plain, 1=seq, 2=nested
+        self.build_spec = None  # (type, bound ctor args) via register_layer
         self._forward_fn = forward_fn
         # declaration order: the default feeding maps reader tuple columns to
         # data layers in the order the user declared them (v2 semantics)

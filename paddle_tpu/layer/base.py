@@ -22,7 +22,41 @@ layer_registry = Registry("layer")
 
 
 def register_layer(name, aliases=()):
-    return layer_registry.register(name, aliases=aliases)
+    """Register a layer constructor AND record each constructed node's
+    build spec (type name + bound constructor arguments) on the node —
+    the raw material for the ModelConfig proto interchange
+    (paddle_tpu/proto: config_parser.py emitted LayerConfig protos; here
+    the spec is captured at construction instead of re-parsed)."""
+    import functools
+    import inspect
+
+    deco = layer_registry.register(name, aliases=aliases)
+
+    def wrap(fn):
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):  # pragma: no cover
+            sig = None
+
+        @functools.wraps(fn)
+        def recorded(*args, **kwargs):
+            node = fn(*args, **kwargs)
+            if isinstance(node, LayerNode) and \
+                    getattr(node, "build_spec", None) is None:
+                bound = dict(kwargs)
+                if sig is not None and args:
+                    try:
+                        ba = sig.bind_partial(*args, **kwargs)
+                        bound = dict(ba.arguments)
+                    except TypeError:  # pragma: no cover
+                        pass
+                node.build_spec = (name, bound)
+            return node
+
+        deco(recorded)
+        return recorded
+
+    return wrap
 
 
 def to_list(inputs):

@@ -159,6 +159,42 @@ class SGD:
             event_handler(v2_event.BeginPass(pass_id))
             eval_acc = {e.name: None for e in self.evaluators}
             batch_id = 0
+            # One-deep input pipeline (PyDataProvider2 pool-thread parity,
+            # TPU-shaped): step k+1's feed is converted and DISPATCHED
+            # before step k's loss/stats are fetched from the device, so
+            # host-side data conversion and event handling overlap the
+            # accelerator — the loop never blocks on a per-batch
+            # device_get before launching the next step. Events still fire
+            # in order with exact values, one dispatch behind; handlers
+            # reading live parameters mid-pass see the in-flight step.
+            pending = None  # (batch_id, loss, stats, feed)
+
+            def finalize(item):
+                b_id, loss, stats, feed = item
+                metrics = {}
+                for e in self.evaluators:
+                    eval_acc[e.name] = e.merge(
+                        eval_acc[e.name], jax.device_get(stats[e.name]))
+                    metrics[e.name] = e.result(eval_acc[e.name])
+                if log_period and b_id % log_period == 0:
+                    logger.info("pass %d batch %d cost=%.6f %s", pass_id,
+                                b_id, float(loss), _fmt_metrics(metrics))
+                    if flags.get_flag("show_layer_stat"):
+                        self._log_layer_stats(feed)
+                psp = flags.get_flag("show_parameter_stats_period")
+                if psp and (self._pending_step_of(b_id)) % max(psp, 1) == 0:
+                    self._log_param_stats()
+                if (test_reader is not None and test_period
+                        and self._pending_step_of(b_id) % test_period == 0):
+                    result = self.test(test_reader, feeding=feeding,
+                                       pass_id=pass_id)
+                    logger.info("periodic test: cost=%.6f %s", result.cost,
+                                _fmt_metrics(result.metrics))
+                    event_handler(result)
+                event_handler(v2_event.EndIteration(
+                    pass_id, b_id, float(loss), metrics))
+
+            self._pass_step_base = self._step_count
             for data_batch in reader():
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
                 with global_stats.timer("feed"):
@@ -170,29 +206,12 @@ class SGD:
                         self._trainable, self._static, self._state,
                         self._opt_state, feed, step_rng)
                 self._step_count += 1
-                metrics = {}
-                for e in self.evaluators:
-                    eval_acc[e.name] = e.merge(eval_acc[e.name],
-                                               jax.device_get(stats[e.name]))
-                    metrics[e.name] = e.result(eval_acc[e.name])
-                if log_period and batch_id % log_period == 0:
-                    logger.info("pass %d batch %d cost=%.6f %s", pass_id,
-                                batch_id, float(loss), _fmt_metrics(metrics))
-                    if flags.get_flag("show_layer_stat"):
-                        self._log_layer_stats(feed)
-                psp = flags.get_flag("show_parameter_stats_period")
-                if psp and self._step_count % psp == 0:
-                    self._log_param_stats()
-                if (test_reader is not None and test_period
-                        and self._step_count % test_period == 0):
-                    result = self.test(test_reader, feeding=feeding,
-                                       pass_id=pass_id)
-                    logger.info("periodic test: cost=%.6f %s", result.cost,
-                                _fmt_metrics(result.metrics))
-                    event_handler(result)
-                event_handler(v2_event.EndIteration(
-                    pass_id, batch_id, float(loss), metrics))
+                if pending is not None:
+                    finalize(pending)
+                pending = (batch_id, loss, stats, feed)
                 batch_id += 1
+            if pending is not None:
+                finalize(pending)
             if test_reader is not None and not test_period:
                 # flag default 0 = one test pass per training pass
                 result = self.test(test_reader, feeding=feeding,
@@ -208,6 +227,11 @@ class SGD:
                 gm=self))
         if sync_params:
             self._sync_back()
+
+    def _pending_step_of(self, batch_id):
+        """Global step number of a pipelined batch being finalized (the
+        periodic-stats/test triggers keep their pre-pipelining schedule)."""
+        return self._pass_step_base + batch_id + 1
 
     def test(self, reader, feeding=None, pass_id=0):
         """One evaluation pass; returns a TestResult event (v2 SGD.test)."""
