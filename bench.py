@@ -165,24 +165,123 @@ def numeric_gate():
             "unit": "checks_passed", "checked": checked}
 
 
-def _timed(build, repeats=3, n1=5, n2=45):
-    """Min ms/batch over ``repeats`` slope measurements + spread.
+def _stats(times):
+    times = sorted(times)
+    best = times[0]
+    mid = times[len(times) // 2] if len(times) % 2 else \
+        0.5 * (times[len(times) // 2 - 1] + times[len(times) // 2])
+    spread = (times[-1] - times[0]) / best * 100.0
+    return {"value_ms": best, "median_ms": mid, "spread": spread,
+            "reps": len(times)}
+
+
+def _timed(build, repeats=3, n1=5, n2=45, streamed_repeats=2):
+    """Min + median ms/batch over ``repeats`` slope measurements.
 
     Min-of-N is the standard noise-robust estimator (cf. timeit): the
     axon tunnel to the shared chip has multi-x throughput fluctuations,
-    and the minimum is the run least polluted by them; spread_pct
-    documents the observed variance."""
-    from benchmark.harness import chain_slope_ms
+    and the minimum is the run least polluted by them; the median rides
+    along so round-over-round comparisons aren't comparing lucky minima
+    (VERDICT r2 weak #6); spread_pct documents the observed variance.
 
-    step, carry, fetch = build()
+    Each step is the REAL train-mode step (dropout + BN updates —
+    benchmark/harness.py). A second measurement streams a fresh host
+    batch through device_put every step (`--job=time` provider-streaming
+    parity); its times return under "streamed"."""
+    from benchmark.harness import chain_slope_ms, streamed_chain_slope_ms
+
+    bundle = build()
     times = []
     for _ in range(repeats):
-        ms, carry = chain_slope_ms(step, carry, fetch, n1=n1, n2=n2)
+        ms, carry = chain_slope_ms(bundle.step, bundle.carry, bundle.fetch,
+                                   n1=n1, n2=n2)
+        bundle.carry = carry
         times.append(ms)
-    times.sort()
-    best = times[0]
-    spread = (times[-1] - times[0]) / best * 100.0
-    return best, spread, len(times)
+    out = _stats(times)
+    if bundle.host_batch is not None and streamed_repeats:
+        stimes = []
+        for _ in range(streamed_repeats):
+            ms, _ = streamed_chain_slope_ms(bundle, n1=max(2, n1 // 2),
+                                            n2=max(6, n2 // 2))
+            stimes.append(ms)
+        out["streamed"] = _stats(stimes)
+    return out
+
+
+def _emit(metric, stats, unit, baseline_ms=None, samples=None, extra=None):
+    """Print the resident-data line and, when measured, the streamed
+    companion (same metric + '_streamed')."""
+    def line(name, st):
+        if samples is not None:
+            value = round(samples / st["value_ms"] * 1000.0, 1)
+            vs = round(value / baseline_ms, 3) if baseline_ms else None
+            med = round(samples / st["median_ms"] * 1000.0, 1)
+        else:
+            value = round(st["value_ms"], 3)
+            vs = round(baseline_ms / value, 3) if baseline_ms else None
+            med = round(st["median_ms"], 3)
+        rec = {"metric": name, "value": value, "unit": unit,
+               "vs_baseline": vs, "median": med,
+               "repeats": st["reps"], "spread_pct": round(st["spread"], 1)}
+        if extra:
+            rec.update(extra)
+        print(json.dumps(rec), flush=True)
+
+    line(metric, stats)
+    if "streamed" in stats:
+        line(metric + "_streamed", stats["streamed"])
+
+
+def _bandwidth_probe():
+    """Host->device device_put bandwidth + fixed cost: the context needed
+    to read the *_streamed rows (on this box the tunnel link, not the
+    chip, bounds any streamed pipeline — memory: 6MB/s, 20ms fixed)."""
+    import time as _time
+
+    import numpy as np
+
+    import jax
+
+    try:
+        rng = np.random.RandomState(0)
+
+        def best_ms(nbytes, n=3):
+            ts = []
+            for _ in range(n):
+                # DISTINCT random payload each rep: the tunnel fast-paths
+                # repeated/zero buffers, which measures nothing real
+                arr = rng.randn(nbytes // 4).astype(np.float32)
+                t0 = _time.perf_counter()
+                jax.block_until_ready(jax.device_put(arr))
+                ts.append((_time.perf_counter() - t0) * 1000.0)
+            return min(ts)
+
+        best_ms(64 * 1024, n=1)  # connection warmup
+        t_small = best_ms(256 * 1024)
+        t_big = best_ms(8 * 1024 * 1024)
+        slope_s = (t_big - t_small) / 1000.0
+        if slope_s <= 0:  # tunnel noise inverted the slope — no number
+            print(json.dumps({
+                "metric": "host_to_device_bandwidth", "value": None,
+                "unit": "MB/s", "fixed_cost_ms": round(t_small, 2),
+                "note": "slope 256KB->8MB came out non-positive (tunnel "
+                        "noise); no bandwidth estimate this run"}),
+                flush=True)
+            return
+        mbps = (8 * 1024 * 1024 - 256 * 1024) / 1e6 / slope_s
+        print(json.dumps({
+            "metric": "host_to_device_bandwidth", "value": round(mbps, 1),
+            "unit": "MB/s", "fixed_cost_ms": round(t_small, 2),
+            "note": "device_put slope 256KB->8MB, fresh random payloads, "
+                    "measured AFTER device compute has run (the state every "
+                    "streamed step sees); bounds every *_streamed row — on "
+                    "real TPU hosts this link is PCIe-class, on the axon "
+                    "tunnel it degrades ~100x once Execute() traffic "
+                    "starts"}), flush=True)
+    except Exception as exc:  # never sink the bench
+        print(json.dumps({"metric": "host_to_device_bandwidth",
+                          "value": None, "error": repr(exc)[:200]}),
+              flush=True)
 
 
 def main():
@@ -190,38 +289,25 @@ def main():
 
     gate = numeric_gate()
     print(json.dumps(gate), flush=True)
+    _bandwidth_probe()
 
-    # ---- CNN family ------------------------------------------------------
-    ms, spread, reps = _timed(lambda: build_image_step("resnet50", 64))
-    print(json.dumps({
-        "metric": "resnet50_train_samples_per_sec_per_chip_bs64",
-        "value": round(64.0 / ms * 1000.0, 1), "unit": "samples/s",
-        "vs_baseline": round(64.0 / ms * 1000.0 / 2000.0, 3),
-        "repeats": reps, "spread_pct": round(spread, 1)}), flush=True)
+    # ---- CNN family (train-mode steps: dropout + BN updates live) --------
+    st = _timed(lambda: build_image_step("resnet50", 64))
+    _emit("resnet50_train_samples_per_sec_per_chip_bs64", st, "samples/s",
+          baseline_ms=2000.0, samples=64.0)
 
-    ms, spread, reps = _timed(lambda: build_image_step("alexnet", 128))
-    print(json.dumps({
-        "metric": "alexnet_train_ms_per_batch_bs128",
-        "value": round(ms, 3), "unit": "ms/batch",
-        "vs_baseline": round(334.0 / ms, 3),
-        "repeats": reps, "spread_pct": round(spread, 1)}), flush=True)
+    st = _timed(lambda: build_image_step("alexnet", 128))
+    _emit("alexnet_train_ms_per_batch_bs128", st, "ms/batch",
+          baseline_ms=334.0)
 
-    ms, spread, reps = _timed(lambda: build_image_step("googlenet", 128),
-                              n2=25)
-    print(json.dumps({
-        "metric": "googlenet_train_ms_per_batch_bs128",
-        "value": round(ms, 3), "unit": "ms/batch",
-        "vs_baseline": round(1149.0 / ms, 3),
-        "repeats": reps, "spread_pct": round(spread, 1)}), flush=True)
+    st = _timed(lambda: build_image_step("googlenet", 128), n2=25)
+    _emit("googlenet_train_ms_per_batch_bs128", st, "ms/batch",
+          baseline_ms=1149.0)
 
     # ---- large-hidden LSTM (tiled fused kernel) --------------------------
-    ms, spread, reps = _timed(lambda: build_rnn_step(batch=64, hidden=1280),
-                              n2=25)
-    print(json.dumps({
-        "metric": "lstm_text_cls_train_ms_per_batch_bs64_h1280",
-        "value": round(ms, 3), "unit": "ms/batch",
-        "vs_baseline": round(641.0 / ms, 3),
-        "repeats": reps, "spread_pct": round(spread, 1)}), flush=True)
+    st = _timed(lambda: build_rnn_step(batch=64, hidden=1280), n2=25)
+    _emit("lstm_text_cls_train_ms_per_batch_bs64_h1280", st, "ms/batch",
+          baseline_ms=641.0)
 
     # ---- DP sharding overhead (8-way virtual CPU mesh) -------------------
     # This host has ONE core: 8 virtual devices time-multiplex it, so true
@@ -261,13 +347,14 @@ def main():
               flush=True)
 
     # ---- flagship LSTM (LAST: the driver's headline line) ----------------
-    ms, spread, reps = _timed(lambda: build_rnn_step(batch=64, hidden=256),
-                              repeats=5, n1=10, n2=110)
-    print(json.dumps({
-        "metric": "lstm_text_cls_train_ms_per_batch_bs64_h256_seq100",
-        "value": round(ms, 3), "unit": "ms/batch",
-        "vs_baseline": round(83.0 / ms, 3),
-        "repeats": reps, "spread_pct": round(spread, 1)}), flush=True)
+    st = _timed(lambda: build_rnn_step(batch=64, hidden=256),
+                repeats=5, n1=10, n2=110)
+    # streamed companion first so the resident flagship stays the last line
+    if "streamed" in st:
+        _emit("lstm_text_cls_train_ms_per_batch_bs64_h256_seq100_streamed",
+              st.pop("streamed"), "ms/batch", baseline_ms=83.0)
+    _emit("lstm_text_cls_train_ms_per_batch_bs64_h256_seq100", st,
+          "ms/batch", baseline_ms=83.0)
 
 
 if __name__ == "__main__":

@@ -51,11 +51,64 @@ def chain_slope_ms(step, carry, fetch, n1=10, n2=110):
     return max(t2 - t1, 1e-9) / (n2 - n1) * 1000.0, carry
 
 
+def streamed_chain_slope_ms(bundle, n1=10, n2=110):
+    """Like chain_slope_ms but every step consumes a FRESH host batch
+    staged via device_put, one batch ahead of compute (double-buffered) —
+    the reference's `--job=time` equally streams provider batches through
+    the training net (paddle/trainer/TrainerBenchmark.cpp). Steady-state
+    per-batch time = max(compute, host->device transfer) when the runtime
+    overlaps them; on links where it cannot, the gap vs the resident
+    column IS the input-pipeline cost."""
+    import jax
+
+    def put(i):
+        return tuple(jax.device_put(x) for x in bundle.host_batch(i))
+
+    def timed(iters, carry, base):
+        start = time.perf_counter()
+        nxt = put(base)
+        for i in range(iters):
+            cur, nxt = nxt, put(base + i + 1)  # prefetch next before compute
+            carry = bundle.step_data(carry, cur)
+        bundle.fetch(carry)
+        return time.perf_counter() - start, carry
+
+    carry = bundle.step_data(bundle.carry, put(0))  # warmup / compile
+    bundle.fetch(carry)
+    t1, carry = timed(n1, carry, 1)
+    t2, carry = timed(n2, carry, n1 + 2)
+    bundle.carry = carry
+    return max(t2 - t1, 1e-9) / (n2 - n1) * 1000.0, carry
+
+
+class StepBundle:
+    """Timeable train step. Unpacks as the classic (step, carry, fetch)
+    triple for resident-data timing; ``step_data``/``host_batch`` feed the
+    streamed path (streamed_chain_slope_ms)."""
+
+    def __init__(self, step, carry, fetch, step_data, host_batch):
+        self.step = step
+        self.carry = carry
+        self.fetch = fetch
+        self.step_data = step_data   # (carry, data_tuple) -> carry
+        self.host_batch = host_batch  # i -> tuple of host numpy arrays
+
+    def __iter__(self):
+        return iter((self.step, self.carry, self.fetch))
+
+
 def _train_step_harness(topo, cost_name, optimizer, feed_of, data,
-                        dp_mesh=None):
-    """Carry = (loss, params, opt_state): the loss rides in the carry so
-    fetch() is a scalar device->host read and chained steps data-depend on
-    each other through the donated params.
+                        dp_mesh=None, host_batch=None):
+    """Carry = (loss, params, state, opt_state, rng): the loss rides in the
+    carry so fetch() is a scalar device->host read and chained steps
+    data-depend on each other through the donated params.
+
+    The step is the REAL training step — mode="train" with dropout active
+    (per-step rng split threaded through the carry) and BN batch stats +
+    moving-average state updates, exactly the graph trainer.py:101-114
+    executes — not a test-mode forward + gradient. The reference's
+    `--job=time` equally times the training network
+    (paddle/trainer/TrainerBenchmark.cpp).
 
     With ``dp_mesh`` (a Mesh with a 'data' axis) the batch is pre-sharded
     over the axis and params/opt state replicated — XLA partitions the
@@ -65,40 +118,50 @@ def _train_step_harness(topo, cost_name, optimizer, feed_of, data,
 
     from paddle_tpu.optimizer import ParamPool
 
-    params = topo.init_params(jax.random.PRNGKey(0))
+    all_params = topo.init_params(jax.random.PRNGKey(0))
+    state_names = {n for n, s in topo.param_specs().items()
+                   if getattr(s, "is_state", False)}
+    state = {k: v for k, v in all_params.items() if k in state_names}
+    params = {k: v for k, v in all_params.items() if k not in state_names}
     pool = ParamPool(params)
     use_pool = pool.enabled() and ParamPool.compatible_with(optimizer)
 
-    def train_step(params, opt_state, *data):
+    def train_step(params, state, opt_state, rng, *data):
+        rng, sub = jax.random.split(rng)
+
         def loss_fn(p):
             full = pool.expand(p) if use_pool else p
-            values, _ = topo.apply(full, feed_of(*data), mode="test")
-            return jnp.mean(values[cost_name])
+            values, updates = topo.apply({**full, **state}, feed_of(*data),
+                                         mode="train", rng=sub)
+            return jnp.mean(values[cost_name]), updates
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        new_params, new_state = optimizer.step(params, grads, opt_state)
-        return loss, new_params, new_state
+        (loss, updates), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt = optimizer.step(params, grads, opt_state)
+        new_state = {**state, **updates}
+        return loss, new_params, new_state, new_opt, rng
 
-    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
     if use_pool:
         # flat master-parameter pool: one fused optimizer update instead
         # of hundreds of tiny per-buffer kernels (ParamPool docstring)
         params = pool.compress(params)
     opt_state = optimizer.init_state(params)
     loss0 = jnp.zeros(())
+    rng0 = jax.random.PRNGKey(1)
     if dp_mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         batch_sh = NamedSharding(dp_mesh, P("data"))
         repl = NamedSharding(dp_mesh, P())
         data = tuple(jax.device_put(d, batch_sh) for d in data)
-        params = jax.tree.map(lambda a: jax.device_put(a, repl), params)
-        opt_state = jax.tree.map(lambda a: jax.device_put(a, repl),
-                                 opt_state)
-        loss0 = jax.device_put(loss0, repl)
-    carry = (loss0, params, opt_state)
-    return (lambda c: jitted(c[1], c[2], *data)), carry, \
-        (lambda c: float(c[0]))
+        params, state, opt_state, loss0, rng0 = jax.tree.map(
+            lambda a: jax.device_put(a, repl),
+            (params, state, opt_state, loss0, rng0))
+    carry = (loss0, params, state, opt_state, rng0)
+    step_data = lambda c, d: jitted(c[1], c[2], c[3], c[4], *d)
+    return StepBundle(lambda c: step_data(c, data), carry,
+                      lambda c: float(c[0]), step_data, host_batch)
 
 
 def build_rnn_step(batch, hidden, seqlen=100, dict_size=30000, emb=128,
@@ -128,8 +191,13 @@ def build_rnn_step(batch, hidden, seqlen=100, dict_size=30000, emb=128,
         jnp.full((batch,), seqlen, jnp.int32),  # reference pads to seqlen
         jnp.asarray(rng.randint(0, classes, (batch,)), jnp.int32),
     )
+    cycle = [(rng.randint(0, dict_size, (batch, seqlen)).astype(np.int32),
+              np.full((batch,), seqlen, np.int32),
+              rng.randint(0, classes, (batch,)).astype(np.int32))
+             for _ in range(4)]
     return _train_step_harness(topo, cost.name, optimizer, feed_of, data,
-                               dp_mesh=dp_mesh)
+                               dp_mesh=dp_mesh,
+                               host_batch=lambda i: cycle[i % len(cycle)])
 
 
 IMAGE_MODELS = {
@@ -165,5 +233,11 @@ def build_image_step(model_name, batch, lr=0.01, dp_mesh=None):
     rng = np.random.RandomState(0)
     data = (jnp.asarray(rng.randn(batch, in_dim), jnp.float32),
             jnp.asarray(rng.randint(0, classes, batch), jnp.int32))
+    # streamed-feed cycle: 2 distinct host batches (large models — keep the
+    # host footprint bounded); fresh labels per batch
+    cycle = [(rng.randn(batch, in_dim).astype(np.float32),
+              rng.randint(0, classes, batch).astype(np.int32))
+             for _ in range(2)]
     return _train_step_harness(topo, cost.name, optimizer, feed_of, data,
-                               dp_mesh=dp_mesh)
+                               dp_mesh=dp_mesh,
+                               host_batch=lambda i: cycle[i % len(cycle)])

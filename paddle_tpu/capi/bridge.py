@@ -3,11 +3,21 @@ interpreter). Owns model construction, parameter loading, and the compiled
 forward; ships float32 row-major bytes back to C."""
 
 import importlib
+import os
 import struct
 
 import numpy as np
 
 _initialized = False
+
+
+def _run_builder(builder_spec):
+    module_name, _, fn_name = builder_spec.partition(":")
+    if not fn_name:
+        raise ValueError(
+            "builder must be 'module.path:function', got %r" % builder_spec)
+    builder = getattr(importlib.import_module(module_name), fn_name)
+    return builder()
 
 
 def initialize(use_tpu):
@@ -21,21 +31,62 @@ def initialize(use_tpu):
     return True
 
 
+def _read_merged(path):
+    """A merged-model tar (cli.py merge_model) bundles
+    merged_manifest.json + model.pb (serialized ModelConfig) +
+    parameters.tar. Returns (manifest, proto_bytes_or_None, params_file)
+    or None when ``path`` is not a merged model."""
+    import io
+    import json
+    import tarfile
+
+    if not (os.path.isfile(path) and tarfile.is_tarfile(path)):
+        return None
+    with tarfile.open(path) as tar:
+        names = tar.getnames()
+        if "merged_manifest.json" not in names:
+            return None
+        if "parameters.tar" not in names:
+            raise ValueError(
+                "merged model %r has no parameters.tar member (members: %s)"
+                % (path, names))
+        manifest = json.loads(tar.extractfile("merged_manifest.json").read())
+        proto = (tar.extractfile("model.pb").read()
+                 if "model.pb" in names else None)
+        params = io.BytesIO(tar.extractfile("parameters.tar").read())
+    return manifest, proto, params
+
+
 class _Model:
     def __init__(self, builder_spec, params_tar):
         from paddle_tpu.inference import Inference
         from paddle_tpu.parameters import Parameters
         from paddle_tpu.graph import reset_name_counters
+        from paddle_tpu.topology import Topology
 
-        module_name, _, fn_name = builder_spec.partition(":")
-        if not fn_name:
-            raise ValueError(
-                "builder must be 'module.path:function', got %r" % builder_spec)
-        builder = getattr(importlib.import_module(module_name), fn_name)
         reset_name_counters()
-        output_layer = builder()
-        with open(params_tar, "rb") as f:
-            params = Parameters.from_tar(f)
+        merged = _read_merged(params_tar)
+        if merged is not None:
+            manifest, proto, params_file = merged
+            if manifest.get("opaque_layers"):
+                # proto alone can't rebuild these layers — use the recorded
+                # builder (the documented escape hatch, interchange.py)
+                proto = None
+            if not builder_spec and proto:
+                # self-contained deployment: rebuild the topology from the
+                # embedded ModelConfig proto — NO user Python executes
+                # (reference: paddle_gradient_machine_create_for_inference
+                # loading MergeModel.cpp output, capi/gradient_machine.h:36)
+                topo = Topology.from_proto(proto)
+                output_layer = topo.outputs
+            else:
+                builder_spec = builder_spec or manifest.get("builder", "")
+                output_layer = _run_builder(builder_spec)
+            params = Parameters.from_tar(params_file)
+        else:
+            output_layer = _run_builder(builder_spec)
+            with open(params_tar, "rb") as f:
+                params = Parameters.from_tar(f)
         self.inference = Inference(output_layer, params)
         self.topology = self.inference.topology
         names = [name for name, _ in self.topology.data_types()]

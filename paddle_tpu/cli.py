@@ -189,9 +189,35 @@ def cmd_checkgrad(args):
 
 
 def cmd_merge_model(args):
-    """MergeModel.cpp parity: bundle builder spec + params into one tar."""
+    """MergeModel.cpp parity: fuse the model topology (a serialized
+    ModelConfig proto, built by re-invoking the builder/config) + params
+    into ONE tar that capi loads without executing any user Python
+    (reference: paddle/trainer/MergeModel.cpp; consumed by
+    paddle_gradient_machine_create_for_inference, capi/gradient_machine.h:36).
+    Layers whose constructor args aren't serializable are recorded opaque;
+    such models keep needing the builder escape hatch (interchange.py)."""
     import tarfile
     import io
+
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.topology import Topology
+    from paddle_tpu.proto.interchange import opaque_layer_names
+
+    reset_name_counters()
+    if args.builder:
+        from paddle_tpu.capi.bridge import _run_builder
+
+        outputs = _run_builder(args.builder)
+    elif args.config:
+        cfg = _load_config(args.config, getattr(args, "config_args", ""))
+        fn = getattr(cfg, "infer_outputs", None) or cfg.cost
+        outputs = fn()
+    else:
+        print("merge_model needs --builder or --config", file=sys.stderr)
+        return 2
+    msg = Topology(outputs).to_proto()
+    opaque = opaque_layer_names(msg)
+    proto_bytes = msg.SerializeToString()
 
     with open(args.params, "rb") as f:
         payload = f.read()
@@ -199,16 +225,23 @@ def cmd_merge_model(args):
         "format": "paddle_tpu-merged-model-v1",
         "builder": args.builder or "",
         "config_file": os.path.basename(args.config or ""),
+        "opaque_layers": opaque,
     }).encode()
     with tarfile.open(args.output, "w") as tar:
         info = tarfile.TarInfo("merged_manifest.json")
         info.size = len(manifest)
         tar.addfile(info, io.BytesIO(manifest))
+        info = tarfile.TarInfo("model.pb")
+        info.size = len(proto_bytes)
+        tar.addfile(info, io.BytesIO(proto_bytes))
         info = tarfile.TarInfo("parameters.tar")
         info.size = len(payload)
         tar.addfile(info, io.BytesIO(payload))
         if args.config:
             tar.add(args.config, arcname=os.path.basename(args.config))
+    if opaque:
+        print("note: opaque layers (builder required at load): %s"
+              % ",".join(opaque))
     print("merged model written to", args.output)
     return 0
 

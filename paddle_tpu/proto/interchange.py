@@ -1,0 +1,237 @@
+"""ModelConfig proto interchange: serialize a Topology to a self-contained
+artifact and rebuild it WITHOUT executing any user config code.
+
+Reference roles covered (SURVEY.md §1 layer 1):
+- config_parser.py emitted a ModelConfig proto the C++ engine consumed
+  (reference: python/paddle/v2/topology.py:64 ``Topology.proto()``,
+  paddle/trainer/config_parser bridge);
+- ``paddle_merge_model`` fused proto+params into one binary that the C
+  inference API loaded with no Python at deployment time (reference:
+  paddle/trainer/MergeModel.cpp, paddle/capi/gradient_machine.h:36).
+
+Design (own, TPU-native): every registered layer constructor records its
+bound arguments on the node it returns (layer/base.py register_layer
+``build_spec``). Serialization is therefore a *re-invocation recipe*: layer
+registry key + JSON-encoded constructor arguments, with layer references
+encoded by name and config-value objects (ParamAttr/ExtraAttr, activations,
+initializers, InputTypes, projections/operators, pooling types) encoded as
+whitelisted-module attribute bags. Deserialization replays the constructors
+in topological order — the rebuilt DAG produces bit-identical programs
+because it runs the exact same layer code with the exact same arguments.
+
+Escape hatch: a node whose recorded arguments contain something
+unserializable (a user lambda, a recurrent_group step closure, a custom
+initializer class outside paddle_tpu) is marked ``opaque`` in the proto.
+``from_proto`` raises on opaque layers unless the caller supplies
+``opaque_builders={layer_name: fn(inputs) -> LayerNode}`` — deployment of
+such models keeps the builder-spec path (capi/bridge.py).
+"""
+
+import importlib
+import json
+
+from paddle_tpu.graph import LayerNode
+from paddle_tpu.utils.error import enforce
+
+# Modules whose instances may appear as layer-constructor arguments and are
+# reconstructible as plain attribute bags (state = vars(obj)). Anything
+# outside this set makes the layer opaque rather than failing the export.
+_OBJ_MODULE_PREFIXES = (
+    "paddle_tpu.attr",
+    "paddle_tpu.activation",
+    "paddle_tpu.initializer",
+    "paddle_tpu.data_type",
+    "paddle_tpu.pooling",
+    "paddle_tpu.layer.",
+    "paddle_tpu.evaluator",
+)
+
+
+class Unserializable(TypeError):
+    """A constructor argument has no proto encoding (→ opaque layer)."""
+
+
+def _is_config_object(value):
+    mod = type(value).__module__ or ""
+    return any(mod == p or (p.endswith(".") and mod.startswith(p))
+               for p in _OBJ_MODULE_PREFIXES)
+
+
+def encode_value(value):
+    """Python constructor argument -> JSON-compatible tagged structure."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, LayerNode):
+        return {"__layer__": value.name}
+    if isinstance(value, (list, tuple)):
+        out = {"__seq__": [encode_value(v) for v in value]}
+        if isinstance(value, tuple):
+            out["tuple"] = True
+        return out
+    if isinstance(value, dict):
+        enforce(all(isinstance(k, str) for k in value),
+                "only str-keyed dicts are serializable")
+        return {"__map__": {k: encode_value(v) for k, v in value.items()}}
+    import numpy as np
+
+    if isinstance(value, np.generic):
+        return value.item()
+    if isinstance(value, type):
+        if _is_config_object_module(value.__module__ or ""):
+            return {"__cls__": "%s:%s" % (value.__module__,
+                                          value.__qualname__)}
+        raise Unserializable("class %r" % (value,))
+    if _is_config_object(value):
+        from paddle_tpu.graph import ParamSpec
+
+        def is_derived(v):
+            # ParamSpecs held by projections/operators are BUILD PRODUCTS
+            # (set by .build() when the owning layer constructor replays) —
+            # serialize them as their initial empty state, not by value
+            return isinstance(v, ParamSpec) or (
+                isinstance(v, (list, tuple)) and len(v) > 0
+                and all(isinstance(i, ParamSpec) for i in v))
+
+        cls = type(value)
+        try:
+            state = {k: (encode_value(None if isinstance(v, ParamSpec)
+                                      else [] if is_derived(v) else v))
+                     for k, v in vars(value).items()
+                     if not k.startswith("_")}
+        except TypeError as exc:  # no __dict__ (slots etc.)
+            raise Unserializable(repr(value)) from exc
+        return {"__obj__": "%s:%s" % (cls.__module__, cls.__qualname__),
+                "state": state}
+    raise Unserializable("%r (%s)" % (value, type(value).__name__))
+
+
+def decode_value(value, nodes):
+    """Inverse of encode_value; ``nodes`` maps layer name -> rebuilt node."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, dict):
+        if "__layer__" in value:
+            name = value["__layer__"]
+            enforce(name in nodes, "layer ref %r not yet built (bad topo "
+                    "order in proto)", name)
+            return nodes[name]
+        if "__seq__" in value:
+            seq = [decode_value(v, nodes) for v in value["__seq__"]]
+            return tuple(seq) if value.get("tuple") else seq
+        if "__map__" in value:
+            return {k: decode_value(v, nodes)
+                    for k, v in value["__map__"].items()}
+        if "__cls__" in value:
+            mod_name, _, cls_name = value["__cls__"].partition(":")
+            enforce(_is_config_object_module(mod_name),
+                    "refusing to resolve class %r: module not whitelisted",
+                    value["__cls__"])
+            return getattr(importlib.import_module(mod_name), cls_name)
+        if "__obj__" in value:
+            mod_name, _, cls_name = value["__obj__"].partition(":")
+            enforce(_is_config_object_module(mod_name),
+                    "refusing to instantiate %r: module not in the config-"
+                    "object whitelist", value["__obj__"])
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            obj = cls.__new__(cls)
+            for k, v in value["state"].items():
+                setattr(obj, k, decode_value(v, nodes))
+            return obj
+    raise TypeError("cannot decode %r" % (value,))
+
+
+def _is_config_object_module(mod):
+    return any(mod == p or (p.endswith(".") and mod.startswith(p))
+               for p in _OBJ_MODULE_PREFIXES)
+
+
+def topology_to_proto(topo):
+    """Topology -> ModelConfig proto message (v2 Topology.proto() parity)."""
+    from paddle_tpu.proto import model_config_pb2 as pb
+
+    msg = pb.ModelConfig()
+    for node in topo.nodes:
+        lc = msg.layers.add()
+        lc.name = node.name
+        lc.size = int(node.size or 0)
+        for parent in node.inputs:
+            lc.inputs.append(parent.name)
+        spec = getattr(node, "build_spec", None)
+        if spec is None:
+            lc.type = node.layer_type
+            lc.opaque = True
+            continue
+        type_name, bound = spec
+        try:
+            attrs = {k: encode_value(v) for k, v in bound.items()}
+        except Unserializable:
+            lc.type = node.layer_type
+            lc.opaque = True
+            continue
+        lc.type = type_name
+        lc.attrs_json = json.dumps(attrs, sort_keys=True)
+    for name, spec in sorted(topo.param_specs().items()):
+        pc = msg.parameters.add()
+        pc.name = name
+        pc.dims.extend(int(d) for d in spec.shape)
+        pc.is_static = bool(getattr(spec.attr, "is_static", False))
+        pc.is_state = bool(getattr(spec, "is_state", False))
+    msg.input_layer_names.extend(n for n, _ in topo.data_types())
+    msg.output_layer_names.extend(o.name for o in topo.outputs)
+    return msg
+
+
+def opaque_layer_names(msg):
+    return [lc.name for lc in msg.layers if lc.opaque]
+
+
+def topology_from_proto(msg, opaque_builders=None):
+    """ModelConfig proto -> list of output LayerNodes (rebuild WITHOUT any
+    user config code). Raises on opaque layers absent from
+    ``opaque_builders``."""
+    import inspect
+
+    from paddle_tpu.layer.base import layer_registry
+
+    if isinstance(msg, (bytes, bytearray)):
+        from paddle_tpu.proto import model_config_pb2 as pb
+
+        raw, msg = msg, pb.ModelConfig()
+        msg.ParseFromString(bytes(raw))
+    nodes = {}
+    for lc in msg.layers:
+        if lc.opaque:
+            builder = (opaque_builders or {}).get(lc.name)
+            enforce(
+                builder is not None,
+                "layer %r (type %s) is opaque — its constructor arguments "
+                "were not serializable (user closure / custom object). "
+                "Rebuild it by passing opaque_builders={%r: fn(inputs)} or "
+                "deploy this model via the builder-spec path "
+                "(capi/bridge.py model_create)", lc.name, lc.type, lc.name)
+            node = builder([nodes[i] for i in lc.inputs])
+        else:
+            fn = layer_registry.get(lc.type)
+            kwargs = {k: decode_value(v, nodes)
+                      for k, v in json.loads(lc.attrs_json or "{}").items()}
+            try:
+                params = inspect.signature(fn).parameters
+                accepts_name = "name" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values())
+            except (TypeError, ValueError):  # pragma: no cover
+                accepts_name = False
+            if accepts_name:
+                # pin the recorded name so auto-name counters can't drift
+                # (param names derive from layer names)
+                kwargs.setdefault("name", lc.name)
+            node = fn(**kwargs)
+        enforce(
+            node.name == lc.name,
+            "rebuilt layer name %r != recorded %r (constructor renamed it)",
+            node.name, lc.name)
+        nodes[lc.name] = node
+    missing = [n for n in msg.output_layer_names if n not in nodes]
+    enforce(not missing, "proto lists outputs %s not among its layers",
+            missing)
+    return [nodes[n] for n in msg.output_layer_names]

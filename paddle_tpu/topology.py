@@ -176,6 +176,26 @@ class Topology:
         ctx = Context(mode=mode, rng=rng)
         return self._run_nodes(params, feed, ctx), ctx.state_updates
 
+    # -- proto interchange --------------------------------------------------
+    def to_proto(self):
+        """Serialize to a ModelConfig proto message — the self-contained
+        deployment artifact (reference: python/paddle/v2/topology.py:64
+        Topology.proto(); consumed by merge_model + capi without user
+        Python)."""
+        from paddle_tpu.proto.interchange import topology_to_proto
+
+        return topology_to_proto(self)
+
+    @classmethod
+    def from_proto(cls, msg, opaque_builders=None):
+        """Rebuild a Topology from a ModelConfig proto (bytes or message)
+        without executing any user config code. Opaque layers (closure-built,
+        e.g. recurrent_group steps) need ``opaque_builders`` — see
+        paddle_tpu/proto/interchange.py."""
+        from paddle_tpu.proto.interchange import topology_from_proto
+
+        return cls(topology_from_proto(msg, opaque_builders))
+
     def data_types(self):
         """[(name, InputType)] for feeder construction, in *declaration
         order* — the default feeding maps reader tuple columns to data layers
