@@ -62,7 +62,19 @@ def streamed_chain_slope_ms(bundle, n1=10, n2=110):
     import jax
 
     def put(i):
-        return tuple(jax.device_put(x) for x in bundle.host_batch(i))
+        batch = bundle.host_batch(i)
+        # cycled host buffers get a cheap in-place perturbation per use so
+        # no transport-level dedup/caching of repeated payloads can
+        # fast-path the transfer (regenerating a full random batch per
+        # step would instead measure host-side numpy time)
+        lead = batch[0]
+        if lead.ndim >= 1 and lead.size:
+            row = lead.reshape(lead.shape[0], -1)[i % lead.shape[0]]
+            if np.issubdtype(lead.dtype, np.floating):
+                row += np.float32(1e-6) * ((i % 7) + 1)
+            else:  # index data: rotate toward 0, stays in-vocabulary
+                np.maximum(row - 1, 0, out=row)
+        return tuple(jax.device_put(x) for x in batch)
 
     def timed(iters, carry, base):
         start = time.perf_counter()

@@ -62,6 +62,23 @@ ExpActivation = _act.Exp
 LogActivation = _act.Log
 AbsActivation = _act.Abs
 SquareActivation = _act.Square
+SqrtActivation = _act.Sqrt
+ReciprocalActivation = _act.Reciprocal
+
+
+# -- sequence level enums (reference: layers.py AggregateLevel/ExpandLevel;
+#    values map onto this framework's agg_level/expand_level ints) ----------
+class AggregateLevel:
+    TO_NO_SEQUENCE = 0   # aggregate whole (nested) sequence -> one row
+    TO_SEQUENCE = 1      # aggregate each sub-sequence -> outer sequence
+    EACH_TIMESTEP = 0    # legacy aliases
+    EACH_SEQUENCE = 1
+
+
+class ExpandLevel:
+    FROM_NO_SEQUENCE = 0
+    FROM_SEQUENCE = 1
+    FROM_TIMESTEP = 0    # legacy alias
 
 # -- poolings ---------------------------------------------------------------
 MaxPooling = _pooling.MaxPooling
@@ -151,6 +168,9 @@ conv_operator = getattr(_L, "conv_operator", None)
 memory = _L.memory
 recurrent_group = _L.recurrent_group
 beam_search = _L.beam_search
+StaticInput = _L.StaticInput
+SubsequenceInput = _L.SubsequenceInput
+GeneratedInput = _L.GeneratedInput
 get_output_layer = getattr(_L, "get_output", None)
 cos_sim = _L.cos_sim
 linear_comb_layer = _L.linear_comb
@@ -175,3 +195,42 @@ from paddle_tpu.networks import (  # noqa: F401
 
 img_conv_group = getattr(_networks, "img_conv_group", None)
 vgg_16_network = getattr(_networks, "vgg_16_network", None)
+bidirectional_gru = _networks.bidirectional_gru
+lstmemory_group = _networks.lstmemory_group
+gru_group = _networks.gru_group
+
+# -- remaining v1 layer names exercised by the reference config corpus ------
+mse_cost = _L.mse_cost
+hsigmoid = _L.hsigmoid
+detection_output_layer = _L.detection_output
+multibox_loss_layer = _L.multibox_loss
+multiplex_layer = _L.multiplex
+prelu_layer = _L.prelu
+gated_unit_layer = _L.gated_unit
+sum_to_one_norm_layer = _L.sum_to_one_norm
+out_prod_layer = getattr(_L, "out_prod", None)
+
+
+# -- layer_math (reference: trainer_config_helpers/layer_math.py — unary
+#    activations as layers + arithmetic operators, which live on LayerNode
+#    itself here, paddle_tpu/graph.py) --------------------------------------
+class _LayerMath:
+    @staticmethod
+    def _unary(x, act):
+        return _L.addto(input=[x], act=act)
+
+
+def _register_unary(op_name, act_cls):
+    setattr(_LayerMath, op_name,
+            staticmethod(lambda x, name=None: _L.addto(input=[x],
+                                                       act=act_cls(),
+                                                       name=name)))
+
+
+for _n, _c in (("exp", _act.Exp), ("log", _act.Log), ("abs", _act.Abs),
+               ("sigmoid", _act.Sigmoid), ("tanh", _act.Tanh),
+               ("square", _act.Square), ("relu", _act.Relu),
+               ("sqrt", _act.Sqrt), ("reciprocal", _act.Reciprocal)):
+    _register_unary(_n, _c)
+
+layer_math = _LayerMath()

@@ -18,6 +18,8 @@ class ParamAttr:
         is_static=False,
         initial_std=None,
         initial_mean=0.0,
+        initial_max=None,
+        initial_min=None,
         initializer=None,
         l1_rate=None,
         l2_rate=None,
@@ -31,6 +33,10 @@ class ParamAttr:
         self.is_static = is_static
         self.initial_std = initial_std
         self.initial_mean = initial_mean
+        # uniform-init bounds (reference ParameterAttribute initial_max/min,
+        # trainer_config_helpers/attrs.py — selects uniform over gaussian)
+        self.initial_max = initial_max
+        self.initial_min = initial_min
         self.initializer = initializer
         self.l1_rate = l1_rate
         self.l2_rate = l2_rate

@@ -81,6 +81,13 @@ class _Model:
                 output_layer = topo.outputs
             else:
                 builder_spec = builder_spec or manifest.get("builder", "")
+                if not builder_spec:
+                    raise ValueError(
+                        "merged model %r contains opaque layers %s (their "
+                        "constructors were not serializable) and records no "
+                        "builder; pass a 'module:function' builder spec to "
+                        "load it (interchange.py escape hatch)"
+                        % (params_tar, manifest.get("opaque_layers")))
                 output_layer = _run_builder(builder_spec)
             params = Parameters.from_tar(params_file)
         else:

@@ -111,10 +111,29 @@ def define_py_data_sources2(train_list=None, test_list=None, module=None,
     direct ``train_reader``/``test_reader`` callables.
     """
     st = _state()
+    if isinstance(module, (list, tuple)):
+        # split data source (reference: data_sources.py — per-split
+        # module/obj/args lists: [train, test])
+        def pick(v, i):
+            return v[i] if isinstance(v, (list, tuple)) else v
+
+        define_py_data_sources2(train_list=train_list, module=pick(module, 0),
+                                obj=pick(obj, 0), args=pick(args, 0))
+        define_py_data_sources2(test_list=test_list, module=pick(module, 1),
+                                obj=pick(obj, 1), args=pick(args, 1))
+        return
     if module is not None:
-        mod = importlib.import_module(module)
-        factory = getattr(mod, obj)
         kwargs = dict(args or {})
+        # import lazily UNLESS the module is already loadable: the reference
+        # parsed configs without importing providers (the trainer imported
+        # them at read time), so a config naming an absent module must
+        # still build
+        try:
+            factory = getattr(importlib.import_module(module), obj)
+        except ImportError:
+            def factory(file_list, _m=module, _o=obj, **kw):
+                return getattr(importlib.import_module(_m), _o)(file_list,
+                                                                **kw)
         if getattr(factory, "is_py_data_provider2", False):
             # @provider-decorated (compat/paddle/trainer/PyDataProvider2):
             # run the init hook now so data_layer() can bind the slot

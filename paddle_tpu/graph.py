@@ -147,16 +147,54 @@ class LayerNode:
             out = self._forward_fn(params, input_values, ctx)
         return out
 
-    # graph sugar: `layer + layer` builds addto, `layer * const` a scale node.
+    # graph sugar (v1 layer_math parity, reference:
+    # trainer_config_helpers/math.py — +,-,* on LayerOutput): layer+layer
+    # builds addto, layer±const slope_intercept, layer*const a scale,
+    # layer*layer a row-wise scaling when either side is width-1.
     def __add__(self, other):
         from paddle_tpu import layer as L
 
-        return L.addto(input=[self, other])
+        if isinstance(other, LayerNode):
+            a, b = self, other
+            if a.size != b.size:
+                # width-1 operand broadcasts (reference layer_math.add
+                # repeats it; addto's elementwise sum broadcasts [B,1]
+                # natively, so no repeat node is needed)
+                if a.size == 1:
+                    a, b = b, a
+                enforce(b.size == 1, "layer + layer needs equal sizes or a "
+                        "width-1 side (%s vs %s)", a.size, b.size)
+            return L.addto(input=[a, b])
+        return L.slope_intercept(input=self, intercept=float(other))
 
-    def __mul__(self, scalar):
+    __radd__ = __add__
+
+    def __sub__(self, other):
         from paddle_tpu import layer as L
 
-        return L.slope_intercept(input=self, slope=float(scalar))
+        if isinstance(other, LayerNode):
+            return L.addto(
+                input=[self, L.slope_intercept(input=other, slope=-1.0)])
+        return L.slope_intercept(input=self, intercept=-float(other))
+
+    def __rsub__(self, other):
+        from paddle_tpu import layer as L
+
+        return L.slope_intercept(input=self, slope=-1.0,
+                                 intercept=float(other))
+
+    def __mul__(self, other):
+        from paddle_tpu import layer as L
+
+        if isinstance(other, LayerNode):
+            if self.size == 1:
+                return L.scaling(input=other, weight=self)
+            if other.size == 1:
+                return L.scaling(input=self, weight=other)
+            raise TypeError(
+                "layer * layer needs one side of width 1 (reference "
+                "layer_math.mul contract); use dotmul for elementwise")
+        return L.slope_intercept(input=self, slope=float(other))
 
     __rmul__ = __mul__
 

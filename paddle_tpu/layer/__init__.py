@@ -102,6 +102,7 @@ from paddle_tpu.layer.rnn_group import (
     BeamSearchGenerator,
     GeneratedInput,
     StaticInput,
+    SubsequenceInput,
     beam_search,
     get_output,
     memory,

@@ -96,10 +96,15 @@ def like(value, new_data):
 
 
 def weight_spec(layer_name, idx, shape, param_attr, fan_in=None):
+    from paddle_tpu.initializer import Uniform
+
     attr = ParamAttr.to_attr(param_attr)
     name = attr.name or "%s.w%d" % (layer_name, idx)
     if attr.initializer is not None:
         init = attr.initializer
+    elif getattr(attr, "initial_max", None) is not None:
+        init = Uniform(attr.initial_min if attr.initial_min is not None
+                       else -attr.initial_max, attr.initial_max)
     elif attr.initial_std is not None:
         init = Normal(attr.initial_mean, attr.initial_std)
     else:

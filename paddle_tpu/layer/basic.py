@@ -106,6 +106,12 @@ def concat(input, name=None, act=None, layer_attr=None):
     adjacent conv layers' own bridges and XLA never materializes the
     spatial-minor form (the flat-NCHW result is bit-identical)."""
     inputs = to_list(input)
+    # the v1 DSL also allows projections here (reference: concat_layer over
+    # identity_projections); each becomes a single-branch mixed layer
+    from paddle_tpu.layer.mixed import BaseProjection, mixed
+
+    inputs = [mixed(input=[i], size=i.size or i.input.size)
+              if isinstance(i, BaseProjection) else i for i in inputs]
     size = sum(i.size for i in inputs)
     shapes = [getattr(i, "out_img_shape", None) for i in inputs]
     img_ok = (all(s is not None for s in shapes)
@@ -282,9 +288,12 @@ def cos_sim(a, b, scale=1.0, size=1, name=None, layer_attr=None):
 
 
 @register_layer("linear_comb")
-def linear_comb(weights, vectors, size, name=None, layer_attr=None):
+def linear_comb(weights, vectors, size=None, name=None, layer_attr=None):
     """z = sum_i w[i] * x[i,:]: weights [B, M], vectors [B, M*size]
-    (reference: LinearCombinationLayer / ConvexCombinationLayer)."""
+    (reference: LinearCombinationLayer / ConvexCombinationLayer;
+    ``size`` defaults to vectors.size // weights.size)."""
+    if size is None:
+        size = vectors.size // weights.size
 
     def forward(params, values, ctx):
         w, v = data_of(values[0]), data_of(values[1])

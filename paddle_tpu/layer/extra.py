@@ -87,11 +87,13 @@ def crf_decoding(input, size=None, label=None, param_attr=None, name=None,
 
 @register_layer("ctc")
 def ctc(input, label, size=None, name=None, norm_by_times=False,
-        layer_attr=None):
+        blank=0, layer_attr=None):
     """CTC cost (reference: CTCLayer / LinearChainCTC; blank = 0 and
     ``size`` = num_classes + 1, same contract). ``input`` is a sequence of
     class scores; softmax-activated inputs are consumed in log space,
     raw scores get log_softmax."""
+    enforce(blank == 0, "ctc: only blank=0 is supported (the reference's "
+            "default convention; remap class ids so blank is 0)")
     size = size or input.size
     is_probs = getattr(input, "output_activation", None) == "softmax"
     inputs = [input, label]
@@ -119,14 +121,17 @@ warp_ctc = ctc  # the reference's WarpCTCLayer is the same loss, GPU-fused;
 
 
 @register_layer("nce")
-def nce(input, label, num_classes, param_attr=None, bias_attr=None,
-        num_neg_samples=10, neg_distribution=None, name=None, layer_attr=None):
+def nce(input, label, num_classes=None, param_attr=None, bias_attr=None,
+        num_neg_samples=10, neg_distribution=None, weight=None, name=None,
+        layer_attr=None):
     """Noise-contrastive estimation cost (reference: NCELayer.cpp —
     per-sample sampled negatives, logistic loss on pos vs noise).
     Output: per-sample cost [B]."""
     from paddle_tpu.graph import auto_name
 
     name = name or auto_name("nce_layer")
+    if num_classes is None:  # v1 DSL default: the label layer's width
+        num_classes = label.size
     feat_dim = input.size
     wspec = weight_spec(name, 0, (num_classes, feat_dim), param_attr,
                         fan_in=feat_dim)
@@ -162,9 +167,13 @@ def nce(input, label, num_classes, param_attr=None, bias_attr=None,
         # stable sigmoid CE
         ce = jnp.maximum(logits, 0) - logits * labels01 + jnp.log1p(
             jnp.exp(-jnp.abs(logits)))
-        return jnp.sum(ce, axis=1)
+        cost = jnp.sum(ce, axis=1)
+        if weight is not None:  # per-sample weight slot (reference: NCELayer
+            cost = cost * data_of(values[2]).reshape(-1)  # weight input)
+        return cost
 
-    return make_node("nce", forward, [input, label], name=name, size=1,
+    inputs = [input, label] + ([weight] if weight is not None else [])
+    return make_node("nce", forward, inputs, name=name, size=1,
                      param_specs=[wspec, bspec], layer_attr=layer_attr)
 
 

@@ -11,6 +11,7 @@ The mixed layer sums all branch outputs, then bias + activation.
 import jax.numpy as jnp
 
 from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.graph import LayerNode
 from paddle_tpu.layer.base import (
     bias_spec,
     data_of,
@@ -169,15 +170,16 @@ class conv_projection(BaseProjection):
     parameter; output is the flattened NCHW feature map."""
 
     def __init__(self, input, filter_size, num_filters, num_channels=None,
-                 stride=1, padding=0, groups=1, param_attr=None):
+                 stride=1, padding=0, groups=1, param_attr=None, trans=False):
         from paddle_tpu.layer.conv import conv_geometry
 
         super(conv_projection, self).__init__(input, None, param_attr)
         (self.c, self.h, self.w, self.fh, self.fw, self.sh, self.sw,
          self.ph, self.pw, self.oh, self.ow) = conv_geometry(
-            input, num_channels, filter_size, stride, padding)
+            input, num_channels, filter_size, stride, padding, trans=trans)
         self.groups = groups
         self.num_filters = num_filters
+        self.trans = trans
         self.size = num_filters * self.oh * self.ow
 
     def build(self, layer_name, idx):
@@ -192,10 +194,15 @@ class conv_projection(BaseProjection):
         from paddle_tpu.ops import conv as conv_ops
 
         x = _to_nhwc(data_of(value), self.c, self.h, self.w)
-        y = conv_ops.conv2d(x, params[self.wspec.name],
-                            stride=(self.sh, self.sw),
-                            padding=((self.ph, self.ph), (self.pw, self.pw)),
-                            groups=self.groups)
+        if getattr(self, "trans", False):
+            y = conv_ops.conv2d_transpose(
+                x, params[self.wspec.name], stride=(self.sh, self.sw),
+                padding=((self.ph, self.ph), (self.pw, self.pw)))
+        else:
+            y = conv_ops.conv2d(
+                x, params[self.wspec.name], stride=(self.sh, self.sw),
+                padding=((self.ph, self.ph), (self.pw, self.pw)),
+                groups=self.groups)
         return like(value, _to_flat(y))
 
 
@@ -207,15 +214,16 @@ class conv_operator:
 
     def __init__(self, img, filter, filter_size, num_filters,
                  num_channels=None, stride=1, padding=0, filter_size_y=None,
-                 stride_y=None, padding_y=None):
+                 stride_y=None, padding_y=None, trans=False):
         from paddle_tpu.layer.conv import conv_geometry
 
         self.inputs = [img, filter]
         (self.c, self.h, self.w, self.fh, self.fw, self.sh, self.sw,
          self.ph, self.pw, self.oh, self.ow) = conv_geometry(
             img, num_channels, filter_size, stride, padding,
-            filter_size_y, stride_y, padding_y)
+            filter_size_y, stride_y, padding_y, trans=trans)
         self.num_filters = num_filters
+        self.trans = trans
         self.size = num_filters * self.oh * self.ow
 
     def forward_op(self, values, ctx):
@@ -231,6 +239,10 @@ class conv_operator:
         ).transpose(0, 3, 4, 2, 1)  # [B, fh, fw, C, K]
 
         def one(img, k):
+            if getattr(self, "trans", False):
+                return conv_ops.conv2d_transpose(
+                    img[None], k, stride=(self.sh, self.sw),
+                    padding=((self.ph, self.ph), (self.pw, self.pw)))[0]
             return conv_ops.conv2d(img[None], k, stride=(self.sh, self.sw),
                                    padding=((self.ph, self.ph),
                                             (self.pw, self.pw)))[0]
@@ -255,7 +267,17 @@ class dotmul_operator:
 def mixed(size=None, input=None, name=None, act=None, bias_attr=False,
           layer_attr=None):
     """Sum of projections/operators + bias + activation (reference:
-    MixedLayer.cpp; DSL mixed_layer)."""
+    MixedLayer.cpp; DSL mixed_layer). With ``input=None`` returns the
+    deferred context-manager form the v1 DSL supports:
+
+        with mixed_layer(size=100) as m:
+            m += full_matrix_projection(input=x)
+
+    (reference: trainer_config_helpers/layers.py MixedLayerType.AddToSealedMixedLayerException
+    — ``+=`` collects projections, layer finalizes at ``with`` exit)."""
+    if input is None:
+        return MixedLayerContext(size=size, name=name, act=act,
+                                 bias_attr=bias_attr, layer_attr=layer_attr)
     branches = to_list(input)
     enforce(len(branches) > 0, "mixed layer needs at least one projection")
     from paddle_tpu.graph import auto_name
@@ -310,3 +332,41 @@ def mixed(size=None, input=None, name=None, act=None, bias_attr=False,
     from paddle_tpu.layer.base import mark_activation
 
     return mark_activation(node, act)
+
+
+class MixedLayerContext(LayerNode):
+    """Deferred mixed layer: collects projections/operators via ``+=`` and
+    becomes the real node when the ``with`` block exits (v1 DSL
+    context-manager form). Subclasses LayerNode so downstream layers can
+    consume it directly after the block; before finalization it has no
+    node state."""
+
+    def __init__(self, size=None, name=None, act=None, bias_attr=False,
+                 layer_attr=None):
+        # deliberately does NOT call LayerNode.__init__: node state arrives
+        # wholesale from the finalized mixed() node
+        self._pending = dict(size=size, name=name, act=act,
+                             bias_attr=bias_attr, layer_attr=layer_attr)
+        self._branches = []
+        self.build_spec = None
+
+    def __iadd__(self, branch):
+        enforce("_pending" in self.__dict__,
+                "mixed layer already finalized; += only works inside the "
+                "with-block")
+        self._branches.append(branch)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            p = self._pending
+            node = mixed(size=p["size"], input=self._branches,
+                         name=p["name"], act=p["act"],
+                         bias_attr=p["bias_attr"],
+                         layer_attr=p["layer_attr"])
+            self.__dict__.clear()
+            self.__dict__.update(vars(node))
+        return False

@@ -94,8 +94,10 @@ def grumemory(input, name=None, size=None, reverse=False, act=None,
     from paddle_tpu.graph import auto_name
 
     name = name or auto_name("grumemory")
-    w_rz = weight_spec(name, 0, (size, 2 * size), param_attr, fan_in=size)
-    w_c = weight_spec(name, 1, (size, size), param_attr, fan_in=size)
+    # ONE recurrent weight [size, 3*size] = [w_r | w_z | w_c], the
+    # reference GatedRecurrentLayer's parameter layout — a single param so
+    # a shared ParamAttr name ties whole GRUs together (shared_gru.py)
+    wspec = weight_spec(name, 0, (size, 3 * size), param_attr, fan_in=size)
     bspec = bias_spec(name, (3 * size,), bias_attr)
     g_act = to_activation(gate_act or "sigmoid").apply
     s_act = to_activation(act or "tanh").apply
@@ -106,20 +108,21 @@ def grumemory(input, name=None, size=None, reverse=False, act=None,
         proj = x.data
         if bspec is not None:
             proj = proj + params[bspec.name]
+        w = params[wspec.name]
         h_seq, _ = rnn_ops.gru_scan(
             proj,
             x.mask(proj.dtype),
             w_in=None,
             b=None,
-            w_rec_rz=params[w_rz.name],
-            w_rec_c=params[w_c.name],
+            w_rec_rz=w[:, :2 * size],
+            w_rec_c=w[:, 2 * size:],
             gate_act=g_act,
             state_act=s_act,
             reverse=reverse,
         )
         return SequenceBatch(h_seq, x.lengths)
 
-    specs = [s for s in (w_rz, w_c, bspec) if s is not None]
+    specs = [s for s in (wspec, bspec) if s is not None]
     return make_node("grumemory", forward, [input], name=name, size=size,
                      param_specs=specs, layer_attr=layer_attr)
 

@@ -95,12 +95,22 @@ def _tagging_init(self, *args, **kwargs):
 LayerNode.__init__ = _tagging_init
 
 
+def SubsequenceInput(input):
+    """Mark a recurrent_group input as nested (reference: SubsequenceInput —
+    the outer group iterates sub-sequences). Here nestedness rides on the
+    VALUE (NestedSequenceBatch) rather than a wrapper type — the group's
+    scan adapts at trace time (_nested_forward) — so this is the identity
+    on the layer node, kept for v1 DSL compatibility."""
+    return input
+
+
 @register_layer("memory")
 def memory(name, size, boot_layer=None, boot_with_const_value=None,
            is_seq=False, boot_bias=None):
     """Previous-step value of the layer called ``name`` (reference: memory()
     DSL; RecurrentGradientMachine memory frames + boot layers). Must be
-    called inside a recurrent_group step function."""
+    called inside a recurrent_group step function. With ``name=None`` the
+    target is bound later via ``.set_input(layer)`` (v1 DSL form)."""
     group = _current_group()
     enforce(group is not None, "memory() must be used inside recurrent_group")
 
@@ -111,6 +121,7 @@ def memory(name, size, boot_layer=None, boot_with_const_value=None,
     node.memory_of = name
     node.boot_layer = boot_layer
     node.boot_const = boot_with_const_value
+    node.set_input = lambda layer: setattr(node, "memory_of", layer.name)
     group["memories"].append(node)
     return node
 

@@ -30,14 +30,42 @@ from paddle_tpu.utils.error import enforce
 
 @register_layer("pooling")
 def pooling(input, pooling_type=None, name=None, bias_attr=False, agg_level=0,
-            layer_attr=None):
+            stride=-1, layer_attr=None):
     """Pool a sequence to one vector per sequence (reference:
     SequencePoolLayer + Max/Average/SumPooling; agg_level selects nested
-    inner pooling: AggregateLevel.TO_SEQUENCE pools each sub-sequence)."""
+    inner pooling: AggregateLevel.TO_SEQUENCE pools each sub-sequence;
+    ``stride`` > 0 pools each stride-window instead, producing a shorter
+    sequence — the reference's seq-pool stride mode)."""
     ptype = to_pooling(pooling_type)
 
     def forward(params, values, ctx):
         x = values[0]
+        if stride > 0:
+            enforce(not isinstance(x, NestedSequenceBatch),
+                    "pooling stride over nested sequences is not supported")
+            enforce(not getattr(ptype, "output_max_index", False),
+                    "pooling stride with output_max_index is not supported")
+        if stride > 0 and isinstance(x, SequenceBatch):
+            b, t, d = x.data.shape
+            k = -(-t // stride)
+            pad = k * stride - t
+            data = jnp.pad(x.data, ((0, 0), (0, pad), (0, 0)))
+            msk = jnp.pad(x.mask(), ((0, 0), (0, pad)))
+            win = data.reshape(b, k, stride, d)
+            wmsk = msk.reshape(b, k, stride)[..., None]
+            if ptype.name == "max":
+                neg = jnp.finfo(win.dtype).min
+                red = jnp.max(jnp.where(wmsk > 0, win, neg), axis=2)
+            else:
+                total = jnp.sum(win * wmsk, axis=2)
+                if ptype.name == "sum":
+                    red = total
+                elif ptype.name == "sqrt_average":
+                    red = total / jnp.sqrt(
+                        jnp.maximum(jnp.sum(wmsk, axis=2), 1.0))
+                else:
+                    red = total / jnp.maximum(jnp.sum(wmsk, axis=2), 1.0)
+            return SequenceBatch(red, -(-x.lengths // stride))
         if isinstance(x, NestedSequenceBatch):
             if agg_level:  # pool each sub-sequence -> outer SequenceBatch
                 inner = x.flatten_to_subsequences()
@@ -65,9 +93,32 @@ def pooling(input, pooling_type=None, name=None, bias_attr=False, agg_level=0,
                      layer_attr=layer_attr)
 
 
+def _strided_pick(x, stride, first):
+    """Window the time axis into ceil(T/stride) windows and keep the
+    first/last VALID step of each window — the reference's seq-pool
+    ``stride`` mode (SequenceLastInstanceLayer with stride): output is a
+    shorter SEQUENCE, one element per window."""
+    b, t, d = x.data.shape
+    k = -(-t // stride)
+    if first:
+        idx = jnp.arange(k) * stride                       # window starts
+    else:
+        ends = jnp.minimum(jnp.arange(1, k + 1) * stride,
+                           x.lengths[:, None])             # [B, K] valid end
+        idx = jnp.maximum(ends - 1, 0)
+    if idx.ndim == 1:
+        picked = x.data[:, idx, :]
+    else:
+        picked = jnp.take_along_axis(x.data, idx[:, :, None], axis=1)
+    new_len = -(-x.lengths // stride)
+    return SequenceBatch(picked, new_len)
+
+
 @register_layer("last_seq")
-def last_seq(input, name=None, agg_level=0, layer_attr=None):
-    """Last timestep of each sequence (reference: SequenceLastInstanceLayer)."""
+def last_seq(input, name=None, agg_level=0, stride=-1, layer_attr=None):
+    """Last timestep of each sequence (reference: SequenceLastInstanceLayer;
+    ``stride`` > 0 keeps the last step of every stride-window instead,
+    producing a shorter sequence)."""
 
     def forward(params, values, ctx):
         x = values[0]
@@ -78,6 +129,8 @@ def last_seq(input, name=None, agg_level=0, layer_attr=None):
             x = SequenceBatch(
                 x.flatten_to_subsequences().data, x.flatten_to_subsequences().lengths
             )
+        if stride > 0:
+            return _strided_pick(x, stride, first=False)
         return x.last_step()
 
     return make_node("last_seq", forward, [input], name=name, size=input.size,
@@ -85,9 +138,9 @@ def last_seq(input, name=None, agg_level=0, layer_attr=None):
 
 
 @register_layer("first_seq")
-def first_seq(input, name=None, agg_level=0, layer_attr=None):
+def first_seq(input, name=None, agg_level=0, stride=-1, layer_attr=None):
     """First timestep of each sequence (reference: SequenceLastInstanceLayer
-    with select_first)."""
+    with select_first; ``stride`` as in :func:`last_seq`)."""
 
     def forward(params, values, ctx):
         x = values[0]
@@ -96,6 +149,8 @@ def first_seq(input, name=None, agg_level=0, layer_attr=None):
                 inner = x.flatten_to_subsequences()
                 return x.outer_sequence_of(inner.first_step())
             return x.data[:, 0, 0]
+        if stride > 0:
+            return _strided_pick(x, stride, first=True)
         return x.first_step()
 
     return make_node("first_seq", forward, [input], name=name, size=input.size,

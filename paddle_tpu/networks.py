@@ -63,11 +63,21 @@ def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
 def bidirectional_lstm(input, size, name=None, return_seq=False,
                        fwd_act=None, bwd_act=None, **kwargs):
     """Forward + backward LSTM, concat (reference: bidirectional_lstm);
-    return_seq=False pools last (fwd) / first (bwd) steps."""
+    return_seq=False pools last (fwd) / first (bwd) steps. ``fwd_*``/
+    ``bwd_*`` kwargs route per direction (see bidirectional_gru)."""
+    fwd_kw, bwd_kw = {}, {}
+    for k, v in kwargs.items():
+        if k.startswith("fwd_"):
+            fwd_kw[k[4:]] = v
+        elif k.startswith("bwd_"):
+            bwd_kw[k[4:]] = v
+        else:
+            fwd_kw[k] = v
+            bwd_kw[k] = v
     fwd = simple_lstm(input, size, name="%s_fwd" % name if name else None,
-                      reverse=False, act=fwd_act)
+                      reverse=False, act=fwd_act, **fwd_kw)
     bwd = simple_lstm(input, size, name="%s_bwd" % name if name else None,
-                      reverse=True, act=bwd_act)
+                      reverse=True, act=bwd_act, **bwd_kw)
     if return_seq:
         return L.concat(input=[fwd, bwd], name=name)
     fwd_last = L.last_seq(input=fwd)
@@ -77,13 +87,81 @@ def bidirectional_lstm(input, size, name=None, return_seq=False,
 
 def simple_gru(input, size, name=None, reverse=False, mat_param_attr=None,
                bias_param_attr=None, inner_param_attr=None, act=None,
-               gate_act=None):
-    proj = L.fc(input=input, size=size * 3, act=None, bias_attr=False,
-                param_attr=mat_param_attr,
+               gate_act=None, mixed_param_attr=None,
+               mixed_bias_param_attr=None, mixed_layer_attr=None,
+               gru_param_attr=None, gru_bias_attr=None, gru_layer_attr=None):
+    """fc (3*size projection) + grumemory. Accepts both this framework's
+    arg names and the v1 DSL's (reference: networks.py simple_gru —
+    mixed_param_attr/gru_param_attr naming)."""
+    mat_param_attr = mixed_param_attr or mat_param_attr
+    inner_param_attr = gru_param_attr or inner_param_attr
+    bias_param_attr = gru_bias_attr if gru_bias_attr is not None \
+        else bias_param_attr
+    proj_bias = mixed_bias_param_attr if mixed_bias_param_attr is not None \
+        else False
+    proj = L.fc(input=input, size=size * 3, act=None, bias_attr=proj_bias,
+                param_attr=mat_param_attr, layer_attr=mixed_layer_attr,
                 name="%s_transform" % name if name else None)
     return L.grumemory(input=proj, size=size, reverse=reverse, act=act,
                        gate_act=gate_act, bias_attr=bias_param_attr,
-                       param_attr=inner_param_attr, name=name)
+                       param_attr=inner_param_attr, layer_attr=gru_layer_attr,
+                       name=name)
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False,
+                      fwd_act=None, bwd_act=None, **kwargs):
+    """Forward + backward GRU, concat (reference: networks.py
+    bidirectional_gru); return_seq=False pools last (fwd) / first (bwd).
+    ``fwd_*``/``bwd_*`` kwargs route to the matching direction's
+    simple_gru (reference attr-routing convention); un-prefixed extras go
+    to both; unknown names raise inside simple_gru rather than being
+    silently dropped."""
+    fwd_kw, bwd_kw = {}, {}
+    for k, v in kwargs.items():
+        if k.startswith("fwd_"):
+            fwd_kw[k[4:]] = v
+        elif k.startswith("bwd_"):
+            bwd_kw[k[4:]] = v
+        else:
+            fwd_kw[k] = v
+            bwd_kw[k] = v
+    fwd = simple_gru(input, size, name="%s_fwd" % name if name else None,
+                     reverse=False, act=fwd_act, **fwd_kw)
+    bwd = simple_gru(input, size, name="%s_bwd" % name if name else None,
+                     reverse=True, act=bwd_act, **bwd_kw)
+    if return_seq:
+        return L.concat(input=[fwd, bwd], name=name)
+    return L.concat(input=[L.last_seq(input=fwd), L.first_seq(input=bwd)],
+                    name=name)
+
+
+def lstmemory_group(input, size=None, name=None, reverse=False,
+                    param_attr=None, act=None, gate_act=None, state_act=None,
+                    input_proj_bias_attr=None, input_proj_layer_attr=None,
+                    lstm_bias_attr=None, lstm_layer_attr=None):
+    """LSTM over a pre-projected sequence — the v1 DSL's recurrent_group
+    spelling of lstmemory (reference: networks.py lstmemory_group builds an
+    explicit per-step sub-network; the math is identical to LstmLayer).
+    TPU-native delta: the recurrence is the same lax.scan/Pallas LSTM as
+    lstmemory — a Python-level per-step subgraph would defeat XLA fusion —
+    so the group attrs map onto the fused layer (docs/DELTAS.md)."""
+    size = size or input.size // 4
+    return L.lstmemory(input=input, size=size, reverse=reverse, act=act,
+                       gate_act=gate_act, state_act=state_act,
+                       bias_attr=lstm_bias_attr, param_attr=param_attr,
+                       layer_attr=lstm_layer_attr, name=name)
+
+
+def gru_group(input, size=None, name=None, reverse=False, param_attr=None,
+              act=None, gate_act=None, gru_bias_attr=None,
+              gru_layer_attr=None):
+    """GRU over a pre-projected sequence (reference: networks.py gru_group;
+    same TPU-native delta as :func:`lstmemory_group`)."""
+    size = size or input.size // 3
+    return L.grumemory(input=input, size=size, reverse=reverse, act=act,
+                       gate_act=gate_act, bias_attr=gru_bias_attr,
+                       param_attr=param_attr, layer_attr=gru_layer_attr,
+                       name=name)
 
 
 def sequence_conv_pool(input, context_len, hidden_size, name=None,

@@ -12,10 +12,17 @@ class BasePoolingType:
 class MaxPooling(BasePoolingType):
     name = "max"
 
-    @staticmethod
-    def reduce(data, mask):
+    def __init__(self, output_max_index=False):
+        # output_max_index: emit the ARGMAX timestep per feature instead of
+        # the max value (reference: MaxPoolingType output_max_index /
+        # MaxIdLayer-style sequence pooling)
+        self.output_max_index = output_max_index
+
+    def reduce(self, data, mask):
         neg = jnp.finfo(data.dtype).min
         masked = jnp.where(mask[..., None], data, neg)
+        if getattr(self, "output_max_index", False):
+            return jnp.argmax(masked, axis=1).astype(data.dtype)
         out = jnp.max(masked, axis=1)
         # all-empty sequences pool to 0 like the reference's empty handling
         any_valid = jnp.any(mask, axis=1)[..., None]

@@ -166,9 +166,9 @@ def test_gru_step_in_group_matches_grumemory():
     fused = L.grumemory(input=xs2, size=hid, bias_attr=False, name="gf")
     topo2 = Topology(fused)
     p2 = dict(topo2.init_params(jax.random.PRNGKey(1)))
-    w = np.asarray(params["gru.w"])
-    p2["gf.w0"] = jnp.asarray(w[:, : 2 * hid])   # update/reset block
-    p2["gf.w1"] = jnp.asarray(w[:, 2 * hid:])    # candidate block
+    # grumemory stores ONE [size, 3*size] = [w_rz | w_c] recurrent weight —
+    # the same layout gru_step uses, so the value maps over verbatim
+    p2["gf.w0"] = jnp.asarray(np.asarray(params["gru.w"]))
     vals2, _ = topo2.apply(p2, feed, mode="test")
     np.testing.assert_allclose(np.asarray(got.data),
                                np.asarray(vals2["gf"].data),

@@ -1,0 +1,263 @@
+"""Config-pair equivalence (VERDICT r2 missing #3).
+
+Reference pattern: paddle/gserver/tests/test_NetworkCompare.cpp:200
+``compareNetwork`` — two DIFFERENT configs that encode the same math are
+trained on the same data and must produce identical outputs and identical
+parameter gradients. Here each pair builds two topologies, maps parameter
+values from A's namespace into B's, and asserts allclose on the forward
+outputs AND on d(loss)/d(param) for every parameter.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _forward_and_grads(topo, params, feed, out_name):
+    def loss_fn(p):
+        values, _ = topo.apply(p, feed, mode="test")
+        v = values[out_name]
+        v = v.data if hasattr(v, "lengths") else v
+        # fixed quadratic loss so gradients exercise the whole graph
+        return jnp.sum(v * v) + jnp.sum(v)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    out, _ = topo.apply(params, feed, mode="test")
+    v = out[out_name]
+    return np.asarray(v.data if hasattr(v, "lengths") else v), loss, grads
+
+
+def _compare_pair(build_a, build_b, feed, param_map=None, rtol=1e-5):
+    """build_* -> (output_node, topology). ``param_map`` maps A-param-name ->
+    (B-param-name, transform) with transform applied to the VALUE when
+    copying, and its inverse-transpose NOT needed because we only compare
+    gradients back in A's namespace via the same transform."""
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.topology import Topology
+
+    reset_name_counters()
+    out_a = build_a()
+    topo_a = Topology(out_a)
+    reset_name_counters()
+    out_b = build_b()
+    topo_b = Topology(out_b)
+
+    params_a = topo_a.init_params(jax.random.PRNGKey(3))
+    param_map = param_map or {}
+    params_b = {}
+    for name_b, spec in topo_b.param_specs().items():
+        src = param_map.get(name_b, (name_b, None))
+        name_a, transform = src if isinstance(src, tuple) else (src, None)
+        val = params_a[name_a]
+        params_b[name_b] = transform(val) if transform else val
+
+    ya, loss_a, grads_a = _forward_and_grads(topo_a, params_a, feed,
+                                             out_a.name)
+    yb, loss_b, grads_b = _forward_and_grads(topo_b, params_b, feed,
+                                             out_b.name)
+    np.testing.assert_allclose(ya, yb, rtol=rtol, atol=1e-5)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=rtol)
+    # gradients: every B param's grad must equal the (transformed) A grad
+    for name_b in grads_b:
+        name_a, transform = (param_map.get(name_b, (name_b, None))
+                             if isinstance(param_map.get(name_b, (name_b,
+                                                                  None)),
+                                           tuple)
+                             else (param_map[name_b], None))
+        ga = grads_a[name_a]
+        if transform:
+            ga = transform(ga)
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(grads_b[name_b]),
+                                   rtol=1e-4, atol=1e-5)
+    return ya
+
+
+def _dense_feed(dim=16, batch=5, names=("x",), seed=0):
+    rng = np.random.RandomState(seed)
+    return {n: jnp.asarray(rng.randn(batch, dim).astype(np.float32))
+            for n in names}
+
+
+def test_fc_vs_mixed_full_matrix_projection():
+    """fc(bias=False, linear) == mixed(full_matrix_projection) — the
+    reference's canonical pair (a mixed layer IS the general fc)."""
+    from paddle_tpu import data_type as dt, layer as L
+    from paddle_tpu.attr import ParamAttr
+
+    def build_a():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        return L.fc(input=x, size=8, bias_attr=False,
+                    param_attr=ParamAttr(name="w"), act=None)
+
+    def build_b():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        return L.mixed(size=8, input=[L.full_matrix_projection(
+            input=x, param_attr=ParamAttr(name="w"))])
+
+    _compare_pair(build_a, build_b, _dense_feed())
+
+
+def test_addto_vs_identity_projections():
+    """addto(a, b) == mixed(identity_projection(a), identity_projection(b))
+    (reference: util_layers concat/addto equivalences)."""
+    from paddle_tpu import data_type as dt, layer as L
+    from paddle_tpu.attr import ParamAttr
+
+    def build_a():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        y = L.data(name="y", type=dt.dense_vector(16))
+        a = L.fc(input=x, size=8, param_attr=ParamAttr(name="wa"),
+                 bias_attr=False)
+        b = L.fc(input=y, size=8, param_attr=ParamAttr(name="wb"),
+                 bias_attr=False)
+        return L.addto(input=[a, b])
+
+    def build_b():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        y = L.data(name="y", type=dt.dense_vector(16))
+        a = L.fc(input=x, size=8, param_attr=ParamAttr(name="wa"),
+                 bias_attr=False)
+        b = L.fc(input=y, size=8, param_attr=ParamAttr(name="wb"),
+                 bias_attr=False)
+        return L.mixed(size=8, input=[L.identity_projection(input=a),
+                                      L.identity_projection(input=b)])
+
+    _compare_pair(build_a, build_b, _dense_feed(names=("x", "y")))
+
+
+def test_trans_projection_vs_transposed_weight():
+    """trans_full_matrix_projection with W == full_matrix_projection with
+    W^T (reference: TransposedFullMatrixProjection pair)."""
+    from paddle_tpu import data_type as dt, layer as L
+    from paddle_tpu.attr import ParamAttr
+
+    def build_a():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        return L.mixed(size=16, input=[L.trans_full_matrix_projection(
+            input=x, param_attr=ParamAttr(name="w"))])
+
+    def build_b():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        return L.mixed(size=16, input=[L.full_matrix_projection(
+            input=x, param_attr=ParamAttr(name="wt"))])
+
+    _compare_pair(build_a, build_b, _dense_feed(),
+                  param_map={"wt": ("w", lambda v: v.T)})
+
+
+def test_shared_weight_vs_untied_copies():
+    """Two fc layers SHARING one named param == two untied fc layers whose
+    params hold identical values; the shared gradient must equal the SUM of
+    the untied gradients (reference: shared_fc semantics,
+    test_CompareTwoNets pattern)."""
+    from paddle_tpu import data_type as dt, layer as L
+    from paddle_tpu.attr import ParamAttr
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.topology import Topology
+
+    feed = _dense_feed(names=("x", "y"))
+
+    def build_shared():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        y = L.data(name="y", type=dt.dense_vector(16))
+        shared = ParamAttr(name="w_shared")
+        a = L.fc(input=x, size=8, param_attr=shared, bias_attr=False)
+        b = L.fc(input=y, size=8, param_attr=shared, bias_attr=False)
+        return L.addto(input=[a, b])
+
+    def build_untied():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        y = L.data(name="y", type=dt.dense_vector(16))
+        a = L.fc(input=x, size=8, param_attr=ParamAttr(name="w_a"),
+                 bias_attr=False)
+        b = L.fc(input=y, size=8, param_attr=ParamAttr(name="w_b"),
+                 bias_attr=False)
+        return L.addto(input=[a, b])
+
+    reset_name_counters()
+    out_s = build_shared()
+    topo_s = Topology(out_s)
+    reset_name_counters()
+    out_u = build_untied()
+    topo_u = Topology(out_u)
+
+    params_s = topo_s.init_params(jax.random.PRNGKey(5))
+    params_u = {"w_a": params_s["w_shared"], "w_b": params_s["w_shared"]}
+
+    ys, loss_s, grads_s = _forward_and_grads(topo_s, params_s, feed,
+                                             out_s.name)
+    yu, loss_u, grads_u = _forward_and_grads(topo_u, params_u, feed,
+                                             out_u.name)
+    np.testing.assert_allclose(ys, yu, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads_s["w_shared"]),
+        np.asarray(grads_u["w_a"]) + np.asarray(grads_u["w_b"]), rtol=1e-4)
+
+
+def test_concat_vs_two_fc_block_weight():
+    """concat(fc_a(x), fc_b(x)) == fc(x) with the block-concatenated weight
+    [Wa | Wb] (reference: concat equivalence configs)."""
+    from paddle_tpu import data_type as dt, layer as L
+    from paddle_tpu.attr import ParamAttr
+
+    def build_a():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        a = L.fc(input=x, size=6, param_attr=ParamAttr(name="wa"),
+                 bias_attr=False)
+        b = L.fc(input=x, size=6, param_attr=ParamAttr(name="wb"),
+                 bias_attr=False)
+        return L.concat(input=[a, b])
+
+    def build_b():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        return L.fc(input=x, size=12, param_attr=ParamAttr(name="wab"),
+                    bias_attr=False)
+
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.topology import Topology
+
+    reset_name_counters()
+    out_a = build_a()
+    topo_a = Topology(out_a)
+    reset_name_counters()
+    out_b = build_b()
+    topo_b = Topology(out_b)
+
+    feed = _dense_feed()
+    params_a = topo_a.init_params(jax.random.PRNGKey(7))
+    params_b = {"wab": jnp.concatenate([params_a["wa"], params_a["wb"]],
+                                       axis=1)}
+    ya, loss_a, grads_a = _forward_and_grads(topo_a, params_a, feed,
+                                             out_a.name)
+    yb, loss_b, grads_b = _forward_and_grads(topo_b, params_b, feed,
+                                             out_b.name)
+    np.testing.assert_allclose(ya, yb, rtol=1e-5)
+    gab = np.asarray(grads_b["wab"])
+    np.testing.assert_allclose(np.asarray(grads_a["wa"]), gab[:, :6],
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(grads_a["wb"]), gab[:, 6:],
+                               rtol=1e-4)
+
+
+def test_scaling_layer_vs_layer_math_mul():
+    """scaling_layer(input, weight) == layer-math ``weight * input`` — the
+    operator overloads must build the same math (reference: math_ops
+    protostr golden asserts the same lowering)."""
+    from paddle_tpu import data_type as dt, layer as L
+
+    def build_a():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        w = L.data(name="w1", type=dt.dense_vector(1))
+        return L.scaling(input=x, weight=w)
+
+    def build_b():
+        x = L.data(name="x", type=dt.dense_vector(16))
+        w = L.data(name="w1", type=dt.dense_vector(1))
+        return w * x
+
+    rng = np.random.RandomState(2)
+    feed = {"x": jnp.asarray(rng.randn(4, 16).astype(np.float32)),
+            "w1": jnp.asarray(rng.randn(4, 1).astype(np.float32))}
+    _compare_pair(build_a, build_b, feed)

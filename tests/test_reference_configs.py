@@ -31,6 +31,7 @@ def _run_cli(config, cwd, extra=(), passes=1, timeout=900):
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PADDLE_TPU_LOG_LEVEL"] = "INFO"  # the asserts read the train log
+    env["PADDLE_TPU_LOG_PERIOD"] = "1"    # every batch logs its cost
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.cli", "train",
@@ -38,6 +39,26 @@ def _run_cli(config, cwd, extra=(), passes=1, timeout=900):
         cwd=cwd, env=env, capture_output=True, text=True, timeout=timeout)
     assert proc.returncode == 0, (proc.stdout[-3000:], proc.stderr[-3000:])
     return proc.stdout + proc.stderr
+
+
+def _assert_cost_decreases(out):
+    """The config must TRAIN, not merely run: per-batch costs are parsed
+    from the train log and the last third must average strictly below the
+    first third (reference contract: a config that parses but diverges is
+    a failure — VERDICT r2 weak #5)."""
+    import re
+
+    costs = [float(m) for m in
+             re.findall(r"pass \d+ batch \d+ cost=([0-9.eE+-]+)", out)]
+    assert len(costs) >= 6, "too few logged costs to judge training: %r" % (
+        costs,)
+    k = max(2, len(costs) // 3)
+    head = sum(costs[:k]) / k
+    tail = sum(costs[-k:]) / k
+    assert tail < head, (
+        "cost did not decrease over training: first-third avg %.6f vs "
+        "last-third avg %.6f (all: %s)" % (head, tail,
+                                           ["%.4f" % c for c in costs]))
 
 
 @pytest.mark.skipif(not os.path.exists(QUICK_START),
@@ -63,8 +84,8 @@ def test_quick_start_lstm_config_runs_verbatim(tmp_path):
     (tmp_path / "data" / "train.list").write_text("data/train.txt\n")
     (tmp_path / "data" / "test.list").write_text("data/test.txt\n")
 
-    out = _run_cli(QUICK_START, str(tmp_path))
-    assert "pass" in out.lower() or "cost" in out.lower(), out[-2000:]
+    out = _run_cli(QUICK_START, str(tmp_path), passes=5, timeout=1500)
+    _assert_cost_decreases(out)
 
 
 @pytest.mark.skipif(not os.path.exists(RNN_BENCH),
@@ -82,6 +103,6 @@ def test_rnn_benchmark_config_runs_verbatim(tmp_path):
         pickle.dump((x[:10], y[:10]), f)
     (tmp_path / "train.list").write_text("imdb.train.pkl\n")
 
-    out = _run_cli(RNN_BENCH, str(tmp_path),
+    out = _run_cli(RNN_BENCH, str(tmp_path), passes=3, timeout=1500,
                    extra=("--config-args", "batch_size=16,hidden_size=32"))
-    assert "pass" in out.lower() or "cost" in out.lower(), out[-2000:]
+    _assert_cost_decreases(out)
