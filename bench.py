@@ -198,6 +198,7 @@ def _timed(build, repeats=3, n1=5, n2=45, streamed_repeats=2):
         bundle.carry = carry
         times.append(ms)
     out = _stats(times)
+    out["flops"] = bundle.train_flops
     if bundle.host_batch is not None and streamed_repeats:
         stimes = []
         for _ in range(streamed_repeats):
@@ -223,6 +224,12 @@ def _emit(metric, stats, unit, baseline_ms=None, samples=None, extra=None):
         rec = {"metric": name, "value": value, "unit": unit,
                "vs_baseline": vs, "median": med,
                "repeats": st["reps"], "spread_pct": round(st["spread"], 1)}
+        from benchmark.harness import achieved
+
+        tflops, mfu = achieved(stats.get("flops"), st["value_ms"])
+        if tflops is not None:
+            rec["tflops"] = round(tflops, 1)
+            rec["mfu_pct"] = round(mfu, 1)
         if extra:
             rec.update(extra)
         print(json.dumps(rec), flush=True)

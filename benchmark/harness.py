@@ -93,24 +93,74 @@ def streamed_chain_slope_ms(bundle, n1=10, n2=110):
     return max(t2 - t1, 1e-9) / (n2 - n1) * 1000.0, carry
 
 
+V5E_PEAK_TFLOPS = 197.0  # bf16 peak of one v5e chip (MXU)
+
+
+def achieved(flops, ms):
+    """(TFLOP/s, MFU %) for a step of ``flops`` taking ``ms`` — the ONE
+    place the peak constant is applied (bench.py and run.py both report
+    these)."""
+    if not flops or not ms or ms != ms:
+        return None, None
+    tflops = flops / (ms / 1000.0) / 1e12
+    return tflops, tflops / V5E_PEAK_TFLOPS * 100.0
+
+
+def topology_fwd_flops(topo, batch, seq_len=1):
+    """Static forward-FLOP estimate: matmul/conv MACs x2 for the layers
+    that carry the arithmetic (conv, fc/mixed projections, recurrent
+    cells); elementwise/pool/norm FLOPs are ignored (they are bandwidth,
+    not MXU, and <2% of the count). Training steps cost ~3x forward
+    (backward-data + backward-filter)."""
+    total = 0
+    for node in topo.nodes:
+        t = node.layer_type
+        spec_args = (node.build_spec or (None, {}))[1]
+        if t == "img_conv":
+            c_out, oh, ow = node.out_img_shape
+            k = spec_args.get("filter_size", 1)
+            kh = k[0] if isinstance(k, (tuple, list)) else k
+            kw = k[1] if isinstance(k, (tuple, list)) else k
+            groups = spec_args.get("groups", 1) or 1
+            c_in = node.inputs[0].out_img_shape[0] \
+                if getattr(node.inputs[0], "out_img_shape", None) \
+                else spec_args.get("num_channels", 1)
+            total += 2 * oh * ow * kh * kw * (c_in // groups) * c_out
+        elif t in ("fc", "mixed", "selective_fc"):
+            for parent in node.inputs:
+                total += 2 * parent.size * node.size
+        elif t == "lstmemory":
+            h = node.size
+            total += seq_len * 2 * h * 4 * h
+        elif t == "grumemory":
+            h = node.size
+            total += seq_len * 2 * h * 3 * h
+        elif t == "embedding":
+            pass  # gather
+    # sequence layers (fc over SequenceBatch) apply per timestep
+    return total * batch
+
+
 class StepBundle:
     """Timeable train step. Unpacks as the classic (step, carry, fetch)
     triple for resident-data timing; ``step_data``/``host_batch`` feed the
     streamed path (streamed_chain_slope_ms)."""
 
-    def __init__(self, step, carry, fetch, step_data, host_batch):
+    def __init__(self, step, carry, fetch, step_data, host_batch,
+                 train_flops=None):
         self.step = step
         self.carry = carry
         self.fetch = fetch
         self.step_data = step_data   # (carry, data_tuple) -> carry
         self.host_batch = host_batch  # i -> tuple of host numpy arrays
+        self.train_flops = train_flops  # static FLOPs of ONE train step
 
     def __iter__(self):
         return iter((self.step, self.carry, self.fetch))
 
 
 def _train_step_harness(topo, cost_name, optimizer, feed_of, data,
-                        dp_mesh=None, host_batch=None):
+                        dp_mesh=None, host_batch=None, train_flops=None):
     """Carry = (loss, params, state, opt_state, rng): the loss rides in the
     carry so fetch() is a scalar device->host read and chained steps
     data-depend on each other through the donated params.
@@ -173,7 +223,8 @@ def _train_step_harness(topo, cost_name, optimizer, feed_of, data,
     carry = (loss0, params, state, opt_state, rng0)
     step_data = lambda c, d: jitted(c[1], c[2], c[3], c[4], *d)
     return StepBundle(lambda c: step_data(c, data), carry,
-                      lambda c: float(c[0]), step_data, host_batch)
+                      lambda c: float(c[0]), step_data, host_batch,
+                      train_flops=train_flops)
 
 
 def build_rnn_step(batch, hidden, seqlen=100, dict_size=30000, emb=128,
@@ -207,9 +258,15 @@ def build_rnn_step(batch, hidden, seqlen=100, dict_size=30000, emb=128,
               np.full((batch,), seqlen, np.int32),
               rng.randint(0, classes, (batch,)).astype(np.int32))
              for _ in range(4)]
+    # 2 LSTM layers (proj d->4h + recurrent h->4h per token) + final fc
+    fwd = batch * seqlen * (2 * (emb * 4 * hidden + hidden * 4 * hidden)
+                            + 2 * (hidden * 4 * hidden
+                                   + hidden * 4 * hidden)) \
+        + batch * 2 * hidden * classes
     return _train_step_harness(topo, cost.name, optimizer, feed_of, data,
                                dp_mesh=dp_mesh,
-                               host_batch=lambda i: cycle[i % len(cycle)])
+                               host_batch=lambda i: cycle[i % len(cycle)],
+                               train_flops=3 * fwd)
 
 
 IMAGE_MODELS = {
@@ -252,4 +309,6 @@ def build_image_step(model_name, batch, lr=0.01, dp_mesh=None):
              for _ in range(2)]
     return _train_step_harness(topo, cost.name, optimizer, feed_of, data,
                                dp_mesh=dp_mesh,
-                               host_batch=lambda i: cycle[i % len(cycle)])
+                               host_batch=lambda i: cycle[i % len(cycle)],
+                               train_flops=3 * topology_fwd_flops(topo,
+                                                                  batch))

@@ -35,7 +35,8 @@ def spawn_coordinator(port, snapshot_path="", task_timeout=600.0,
 
     sel = selectors.DefaultSelector()
     sel.register(proc.stderr, selectors.EVENT_READ)
-    deadline = time.time() + 60
+    deadline = time.time() + 180  # 60s fired spuriously when the
+    # single-core host also runs the test suite (subprocess starvation)
     try:
         while time.time() < deadline:
             if not sel.select(timeout=max(0.0, deadline - time.time())):
@@ -51,7 +52,7 @@ def spawn_coordinator(port, snapshot_path="", task_timeout=600.0,
     finally:
         sel.close()
     proc.kill()
-    raise RuntimeError("coordinator did not start within 60s")
+    raise RuntimeError("coordinator did not start within 180s")
 
 
 def spawn_coordinator_on_free_port(snapshot_path="", task_timeout=600.0,

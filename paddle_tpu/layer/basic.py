@@ -119,15 +119,16 @@ def concat(input, name=None, act=None, layer_attr=None):
 
     def forward(params, values, ctx):
         from paddle_tpu.activation import to_activation
-        from paddle_tpu.layer.conv import _to_flat, _to_nhwc
+        from paddle_tpu.layer.base import ImageValue, as_nhwc
+        from paddle_tpu.layer.conv import _to_flat
 
         if img_ok and not any(is_seq(v) for v in values):
-            nhwc = [_to_nhwc(data_of(v), *s)
-                    for v, s in zip(values, shapes)]
+            nhwc = [as_nhwc(v, *s) for v, s in zip(values, shapes)]
             y = jnp.concatenate(nhwc, axis=-1)
+            out_shape = (sum(s[0] for s in shapes),) + shapes[0][1:]
             if getattr(to_activation(act), "elementwise", True):
                 y = finalize(y, act, node.extra_attr, ctx)
-                return _to_flat(y)
+                return ImageValue(y, out_shape)  # NHWC-resident channel concat
             return finalize(_to_flat(y), act, node.extra_attr, ctx)
         datas = [data_of(v) for v in values]
         out = like(values[0], jnp.concatenate(datas, axis=-1))
@@ -158,14 +159,15 @@ def addto(input, name=None, act=None, bias_attr=False, layer_attr=None):
 
         if (img_ok and bspec is None and not any(is_seq(v) for v in values)
                 and getattr(to_activation(act), "elementwise", True)):
-            # image residual-add (ResNet shortcut) in NHWC — the layout
-            # bridges cancel with the adjacent conv/bn layers' bridges
-            from paddle_tpu.layer.conv import _to_flat, _to_nhwc
+            # image residual-add (ResNet shortcut): NHWC-resident, no
+            # layout bridges at the block fan-in
+            from paddle_tpu.layer.base import ImageValue, as_nhwc
 
-            y = _to_nhwc(data_of(values[0]), *shapes[0])
+            y = as_nhwc(values[0], *shapes[0])
             for v in values[1:]:
-                y = y + _to_nhwc(data_of(v), *shapes[0])
-            return _to_flat(finalize(y, act, node.extra_attr, ctx))
+                y = y + as_nhwc(v, *shapes[0])
+            return ImageValue(finalize(y, act, node.extra_attr, ctx),
+                              shapes[0])
         out = data_of(values[0])
         for v in values[1:]:
             out = out + data_of(v)

@@ -20,10 +20,14 @@ import jax.numpy as jnp
 
 from paddle_tpu.graph import ParamSpec
 from paddle_tpu.initializer import Constant, Normal, Xavier
+from paddle_tpu.core.sequence import NestedSequenceBatch
 from paddle_tpu.layer.base import (
+    ImageValue,
+    as_nhwc,
     bias_spec,
     data_of,
     finalize,
+    is_seq,
     like,
     make_node,
     register_layer,
@@ -115,7 +119,9 @@ def img_conv(input, filter_size, num_filters, name=None, num_channels=None,
     def forward(params, values, ctx):
         from paddle_tpu.activation import to_activation
 
-        x = _to_nhwc(data_of(values[0]), c, h, w)
+        vin = values[0]
+        seq = is_seq(vin) or isinstance(vin, NestedSequenceBatch)
+        x = as_nhwc(vin, c, h, w)
         kernel = params[wspec.name]
         if trans:
             y = conv_ops.conv2d_transpose(
@@ -129,15 +135,16 @@ def img_conv(input, filter_size, num_filters, name=None, num_channels=None,
             y = y + params[bspec.name]
         if ((bspec is None or shared_biases)
                 and getattr(to_activation(act), "elementwise", True)):
-            # activation (+dropout) in NHWC: keeps channels on the lane
-            # axis so XLA never materializes activations spatial-minor,
-            # and the flat<->NHWC bridges of adjacent image layers cancel
+            # activation (+dropout) in NHWC: channels stay on the lane
+            # axis; the value stays NHWC-resident for the next image layer
             y = finalize(y, act, node.extra_attr, ctx)
-            return like(values[0], _to_flat(y))
+            if not seq:
+                return ImageValue(y, (num_filters, oh, ow))
+            return like(vin, _to_flat(y))
         flat = _to_flat(y)
         if bspec is not None and not shared_biases:
             flat = flat + params[bspec.name]
-        return finalize(like(values[0], flat), act, node.extra_attr, ctx)
+        return finalize(like(vin, flat), act, node.extra_attr, ctx)
 
     node = make_node("img_conv", forward, [input], name=name,
                      size=num_filters * oh * ow,
@@ -171,14 +178,18 @@ def img_pool(input, pool_size, name=None, num_channels=None, pool_type=None,
         ow = (w + 2 * pw - fw) // sw + 1
 
     def forward(params, values, ctx):
-        x = _to_nhwc(data_of(values[0]), c, h, w)
+        vin = values[0]
+        seq = is_seq(vin) or isinstance(vin, NestedSequenceBatch)
+        x = as_nhwc(vin, c, h, w)
         if isinstance(ptype, MaxPooling):
             y = conv_ops.max_pool2d(x, (fh, fw), (sh, sw), (ph, pw), ceil_mode)
         else:
             y = conv_ops.avg_pool2d(x, (fh, fw), (sh, sw), (ph, pw), ceil_mode,
                                     exclude_padding=exclude_mode)
         y = y[:, :oh, :ow, :]
-        return like(values[0], _to_flat(y))
+        if not seq:
+            return ImageValue(y, (c, oh, ow))
+        return like(vin, _to_flat(y))
 
     node = make_node("img_pool", forward, [input], name=name, size=c * oh * ow,
                      layer_attr=layer_attr)
@@ -209,15 +220,16 @@ def batch_norm(input, name=None, num_channels=None, act=None, bias_attr=None,
                          is_state=True)
 
     def forward(params, values, ctx):
-        flat = data_of(values[0])
+        vin = values[0]
+        seq = is_seq(vin) or isinstance(vin, NestedSequenceBatch)
         g, b = params[gamma.name], params[beta.name]
         mm, mv = params[mean_spec.name], params[var_spec.name]
         if shape:
             c, h, w = shape
-            x = _to_nhwc(flat, c, h, w)
+            x = as_nhwc(vin, c, h, w)
             axes = (0, 1, 2)
         else:
-            x = flat
+            x = data_of(vin)
             axes = (0,)
         use_stats = use_global_stats if use_global_stats is not None else not ctx.is_train
         if use_stats:
@@ -231,9 +243,11 @@ def batch_norm(input, name=None, num_channels=None, act=None, bias_attr=None,
 
         if shape and getattr(to_activation(act), "elementwise", True):
             y = finalize(y, act, node.extra_attr, ctx)  # NHWC, lane-friendly
-            return like(values[0], _to_flat(y))
+            if not seq:
+                return ImageValue(y, shape)
+            return like(vin, _to_flat(y))
         out = _to_flat(y) if shape else y
-        return finalize(like(values[0], out), act, node.extra_attr, ctx)
+        return finalize(like(vin, out), act, node.extra_attr, ctx)
 
     node = make_node("batch_norm", forward, [input], name=name, size=input.size,
                      param_specs=[gamma, beta, mean_spec, var_spec],
@@ -251,9 +265,13 @@ def img_cmrnorm(input, size, scale=0.0128, power=0.75, name=None,
     c, h, w = _img_shape(input, num_channels)
 
     def forward(params, values, ctx):
-        x = _to_nhwc(data_of(values[0]), c, h, w)
-        y = conv_ops.cross_map_norm(x, size, scale * size, power)
-        return like(values[0], _to_flat(y))
+        vin = values[0]
+        seq = is_seq(vin) or isinstance(vin, NestedSequenceBatch)
+        x = as_nhwc(vin, c, h, w)
+        y = conv_ops.cross_map_norm_auto(x, size, scale * size, power)
+        if not seq:
+            return ImageValue(y, (c, h, w))
+        return like(vin, _to_flat(y))
 
     node = make_node("img_cmrnorm", forward, [input], name=name,
                      size=input.size, layer_attr=layer_attr)
@@ -272,7 +290,7 @@ def spp(input, name=None, num_channels=None, pool_type=None, pyramid_height=3,
     total_bins = sum(4 ** l for l in range(pyramid_height))
 
     def forward(params, values, ctx):
-        x = _to_nhwc(data_of(values[0]), c, h, w)
+        x = as_nhwc(values[0], c, h, w)
         return like(values[0], conv_ops.spatial_pyramid_pool(x, pyramid_height, ptype))
 
     return make_node("spp", forward, [input], name=name, size=total_bins * c,
@@ -286,7 +304,7 @@ def maxout(input, groups, name=None, num_channels=None, layer_attr=None):
     enforce(c % groups == 0, "maxout channels %d not divisible by groups %d", c, groups)
 
     def forward(params, values, ctx):
-        x = _to_nhwc(data_of(values[0]), c, h, w)
+        x = as_nhwc(values[0], c, h, w)
         return like(values[0], _to_flat(conv_ops.maxout(x, groups)))
 
     node = make_node("maxout", forward, [input], name=name,
@@ -387,7 +405,7 @@ def bilinear_interp(input, out_size_x, out_size_y, name=None, layer_attr=None):
     def forward(params, values, ctx):
         import jax
 
-        x = _to_nhwc(data_of(values[0]), c, h, w)
+        x = as_nhwc(values[0], c, h, w)
         y = jax.image.resize(
             x, (x.shape[0], out_size_y, out_size_x, c), method="linear")
         return like(values[0], _to_flat(y))

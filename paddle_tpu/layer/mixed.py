@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from paddle_tpu.core.sequence import SequenceBatch
 from paddle_tpu.graph import LayerNode
 from paddle_tpu.layer.base import (
+    as_nhwc,
     bias_spec,
     data_of,
     featurewise,
@@ -193,7 +194,7 @@ class conv_projection(BaseProjection):
         from paddle_tpu.layer.conv import _to_flat, _to_nhwc
         from paddle_tpu.ops import conv as conv_ops
 
-        x = _to_nhwc(data_of(value), self.c, self.h, self.w)
+        x = as_nhwc(value, self.c, self.h, self.w)
         if getattr(self, "trans", False):
             y = conv_ops.conv2d_transpose(
                 x, params[self.wspec.name], stride=(self.sh, self.sw),
@@ -232,7 +233,7 @@ class conv_operator:
         from paddle_tpu.layer.conv import _to_flat, _to_nhwc
         from paddle_tpu.ops import conv as conv_ops
 
-        x = _to_nhwc(data_of(values[0]), self.c, self.h, self.w)
+        x = as_nhwc(values[0], self.c, self.h, self.w)
         # per-sample filters: vmap the conv over the batch
         filt = data_of(values[1]).reshape(
             -1, self.num_filters, self.c, self.fh, self.fw

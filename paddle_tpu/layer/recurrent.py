@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from paddle_tpu.activation import to_activation
 from paddle_tpu.core.sequence import SequenceBatch
 from paddle_tpu.layer.base import (
+    as_nhwc,
     bias_spec,
     data_of,
     is_seq,
@@ -176,7 +177,7 @@ def mdlstmemory(input, size, directions=(True, True), name=None,
                       if bias_attr is not None else True)
 
     def forward(params, values, ctx):
-        x = _to_nhwc(data_of(values[0]), c, h, w)
+        x = as_nhwc(values[0], c, h, w)
         if not directions[0]:
             x = x[:, ::-1]
         if not directions[1]:

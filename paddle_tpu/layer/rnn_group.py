@@ -301,7 +301,16 @@ def _nested_forward(program, slot_of, graph_inputs, out_idx, reverse,
             new = data_of(vals[id(program.by_name[m.memory_of])])
             keep = step_mask[:, None].astype(new.dtype)
             new_mems.append(new * keep + old * (1.0 - keep))
-        return tuple(new_mems), tuple(vals[id(o)]
+        # a step ending in an image layer yields an NHWC-resident
+        # ImageValue (layer/base.py) — not a pytree, so materialize its
+        # flat view for scan; SequenceBatch outputs (nested inner groups)
+        # ARE pytrees and pass through with their lengths intact
+        def scannable(v):
+            from paddle_tpu.layer.base import ImageValue
+
+            return v.flat() if isinstance(v, ImageValue) else v
+
+        return tuple(new_mems), tuple(scannable(vals[id(o)])
                                       for o in program.outputs)
 
     _, ys_all = lax.scan(body, tuple(boots),

@@ -20,6 +20,15 @@ from paddle_tpu.graph import Context, LayerNode, topo_sort
 from paddle_tpu.utils.error import enforce
 
 
+def _external(value):
+    """Values crossing the topology boundary keep the reference's flat
+    NCHW contract: NHWC-resident intermediates (layer/base.py ImageValue)
+    materialize their flat view here."""
+    from paddle_tpu.layer.base import ImageValue
+
+    return value.flat() if isinstance(value, ImageValue) else value
+
+
 def _layer_sharding_constraint(value, spec):
     """Lower ExtraAttr(sharding=...) to with_sharding_constraint against
     the active mesh (parallel.mesh.use_mesh). No active mesh -> no-op, so
@@ -31,6 +40,8 @@ def _layer_sharding_constraint(value, spec):
     mesh = mesh_mod.current_mesh()
     if mesh is None:
         return value
+    # sharding specs address the flat [B, C*H*W] contract — materialize it
+    value = _external(value)
     sharding = NamedSharding(mesh, PartitionSpec(*spec))
     constrain = lambda a: jax.lax.with_sharding_constraint(a, sharding)
     if isinstance(value, (SequenceBatch, NestedSequenceBatch)):
@@ -129,7 +140,8 @@ class Topology:
         ctx = Context(mode=mode, rng=rng)
         values = self._run_nodes(params, feed, ctx)
         wanted = outputs or [o.name for o in self.outputs]
-        return {name: values[name] for name in wanted}, ctx.state_updates
+        return {name: _external(values[name]) for name in wanted}, \
+            ctx.state_updates
 
     def _run_nodes(self, params, feed, ctx):
         cd = dtype_mod.compute_dtype()
@@ -174,7 +186,8 @@ class Topology:
         """Like apply() but returns every layer's value (debug / tests /
         --show_layer_stat parity)."""
         ctx = Context(mode=mode, rng=rng)
-        return self._run_nodes(params, feed, ctx), ctx.state_updates
+        values = self._run_nodes(params, feed, ctx)
+        return {k: _external(v) for k, v in values.items()}, ctx.state_updates
 
     # -- proto interchange --------------------------------------------------
     def to_proto(self):
