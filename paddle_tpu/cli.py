@@ -78,9 +78,10 @@ def _load_config(path, config_args=""):
     return mod
 
 
-def _build(cfg):
+def _build(cfg, parallelism=None):
     import paddle_tpu as paddle
     from paddle_tpu.parameters import Parameters
+    from paddle_tpu.utils import flags
 
     cost = cfg.cost()
     params = Parameters.create(cost)
@@ -91,7 +92,25 @@ def _build(cfg):
 
         optimizer = opt.Momentum(learning_rate=0.01, momentum=0.9)
     extra = list(cfg.evaluators()) if hasattr(cfg, "evaluators") else None
-    trainer = paddle.trainer.SGD(cost, params, optimizer, extra_layers=extra)
+    # --trainer-count N (reference: --trainer_count spun N worker threads,
+    # MultiGradientMachine): here it builds an N-device data-parallel mesh
+    # and pjits the train step over it — XLA inserts the gradient psum
+    tc = flags.get_flag("trainer_count") or 1
+    if parallelism is None and tc > 1:
+        import jax
+
+        from paddle_tpu.parallel.mesh import DataParallel, build_mesh
+
+        n_dev = len(jax.devices())
+        if tc > n_dev:
+            raise SystemExit(
+                "--trainer-count %d exceeds the %d visible devices "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                "for a virtual CPU mesh)" % (tc, n_dev))
+        parallelism = DataParallel(
+            build_mesh({"data": tc}, devices=jax.devices()[:tc]))
+    trainer = paddle.trainer.SGD(cost, params, optimizer, extra_layers=extra,
+                                 parallelism=parallelism)
     return cost, params, trainer
 
 
@@ -188,6 +207,27 @@ def cmd_checkgrad(args):
     return 0
 
 
+def cmd_cluster_train(args):
+    """Cluster launcher job (reference: scripts/cluster_train/paddle.py —
+    started pservers+trainers across hosts; pserver-free here, see
+    distributed/launcher.py)."""
+    from paddle_tpu.distributed.launcher import launch_local_cluster
+    from paddle_tpu.utils import flags
+
+    if (flags.get_flag("trainer_count") or 1) > 1:
+        raise SystemExit(
+            "--trainer-count does not apply to cluster_train: every worker "
+            "spans the GLOBAL mesh; use --num-processes (and per-host "
+            "device visibility) to set the parallel width")
+    results = launch_local_cluster(
+        args.config, args.num_processes, num_passes=args.num_passes,
+        config_args=args.config_args,
+        devices_per_process=args.devices_per_process)
+    for r in results:
+        print(json.dumps(r))
+    return 0
+
+
 def cmd_merge_model(args):
     """MergeModel.cpp parity: fuse the model topology (a serialized
     ModelConfig proto, built by re-invoking the builder/config) + params
@@ -258,6 +298,9 @@ def main(argv=None):
                              "paddle_tpu.config.get_config_arg")
     common.add_argument("--batch-size", type=int, default=64)
     common.add_argument("--use-tpu", action="store_true", default=None)
+    common.add_argument("--trainer-count", type=int, default=None,
+                        help="data-parallel width over visible devices "
+                             "(reference --trainer_count)")
 
     p = sub.add_parser("train", parents=[common])
     p.add_argument("--num-passes", type=int, default=1)
@@ -276,6 +319,14 @@ def main(argv=None):
     p = sub.add_parser("checkgrad", parents=[common])
     p.set_defaults(fn=cmd_checkgrad)
 
+    p = sub.add_parser("cluster_train", parents=[common])
+    p.add_argument("--num-processes", type=int, required=True,
+                   help="worker processes (1 per host slot)")
+    p.add_argument("--num-passes", type=int, default=1)
+    p.add_argument("--devices-per-process", type=int, default=None,
+                   help="virtual CPU devices per worker (testing)")
+    p.set_defaults(fn=cmd_cluster_train)
+
     p = sub.add_parser("merge_model")
     p.add_argument("--config", default="")
     p.add_argument("--builder", default="")
@@ -288,6 +339,10 @@ def main(argv=None):
         import paddle_tpu as paddle
 
         paddle.init(use_tpu=args.use_tpu)
+    if getattr(args, "trainer_count", None):
+        from paddle_tpu.utils import flags
+
+        flags.set_flag("trainer_count", args.trainer_count)
     return args.fn(args)
 
 

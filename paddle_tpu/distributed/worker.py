@@ -1,0 +1,74 @@
+"""Cluster worker: one jax.distributed participant of a multi-host data-
+parallel training job.
+
+Reference roles: the per-host trainer process the cluster launcher started
+(paddle/scripts/cluster_train/paddle.py job_trainer :130 — each host ran
+`paddle train` with trainer_id/num_gradient_servers set). Here a worker:
+
+1. joins the process group (distributed/multihost.py -> jax.distributed),
+2. builds the user config's topology and a DataParallel plan over the
+   GLOBAL mesh (all devices of all processes) — gradients psum over
+   ICI/DCN with no parameter server,
+3. runs the standard SGD loop; every process feeds the identical batch
+   stream (same reader seed) and jax.device_put shards it onto the global
+   'data' axis, each process materializing only its local shard,
+4. prints per-pass costs + a final RESULT line the launcher collects.
+
+Run via `python -m paddle_tpu.distributed.worker ...` (the launcher does).
+"""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="paddle_tpu.distributed.worker")
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--config-args", default="")
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--coordinator", required=True,
+                    help="host:port of the jax.distributed coordinator")
+    ap.add_argument("--num-passes", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.distributed.multihost import initialize_multihost
+
+    ok = initialize_multihost(coordinator_address=args.coordinator,
+                              num_processes=args.num_processes,
+                              process_id=args.process_id)
+    assert ok, "jax.distributed initialization failed"
+
+    import jax
+
+    from paddle_tpu import minibatch
+    from paddle_tpu.cli import _build, _load_config
+    from paddle_tpu.parallel.mesh import DataParallel, build_mesh
+
+    cfg = _load_config(args.config, args.config_args)
+    # the GLOBAL mesh: every process contributes its local devices; built
+    # before the trainer so __prepare__ runs ONCE with the sharded plan
+    mesh = build_mesh({"data": jax.device_count()})
+    cost, params, trainer = _build(cfg, parallelism=DataParallel(mesh))
+
+    # config's batch_size wins, like the train job (cmd_train)
+    batch_size = getattr(cfg, "batch_size", None) or args.batch_size or 64
+    reader = minibatch.batch(cfg.train_reader(), batch_size)
+    costs = []
+    trainer.train(reader, num_passes=args.num_passes,
+                  event_handler=lambda e: costs.append(float(e.cost))
+                  if getattr(e, "cost", None) is not None else None)
+
+    final = {"process_id": args.process_id,
+             "processes": jax.process_count(),
+             "global_devices": jax.device_count(),
+             "first_cost": costs[0] if costs else None,
+             "final_cost": costs[-1] if costs else None}
+    print("CLUSTER_RESULT " + json.dumps(final), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

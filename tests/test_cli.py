@@ -138,3 +138,36 @@ def test_get_config_arg_types():
     assert cfgmod.get_config_arg("c") == "hi"
     assert cfgmod.get_config_arg("missing", int, 7) == 7
     cfgmod.reset()
+
+
+def test_train_with_trainer_count_dp(config_file, tmp_path):
+    """--trainer-count N builds an N-device data-parallel mesh for the
+    train step (reference: --trainer_count spun N MultiGradientMachine
+    worker threads). Runs on a 4-device virtual CPU mesh."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO
+    env["PADDLE_TPU_LOG_LEVEL"] = "INFO"
+    env["PADDLE_TPU_LOG_PERIOD"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.cli", "train",
+         "--config", str(config_file), "--num-passes", "2",
+         "--trainer-count", "4"],
+        capture_output=True, text=True, env=env, timeout=600, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    import re
+
+    costs = [float(m) for m in
+             re.findall(r"pass \d+ batch \d+ cost=([0-9.eE+-]+)",
+                        proc.stdout + proc.stderr)]
+    assert len(costs) >= 4
+    assert costs[-1] < costs[0]
+
+
+def test_trainer_count_too_large_fails_cleanly(config_file):
+    proc = _run_cli(["train", "--config", str(config_file),
+                     "--trainer-count", "64"])
+    assert proc.returncode != 0
+    assert "exceeds" in proc.stderr + proc.stdout

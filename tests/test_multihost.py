@@ -114,3 +114,48 @@ def test_two_process_jax_distributed_train_step(tmp_path):
     # the psum proof: same loss, identical post-step parameters
     assert abs(results[0]["loss"] - results[1]["loss"]) < 1e-6
     assert abs(results[0]["checksum"] - results[1]["checksum"]) < 1e-5
+
+
+def test_cluster_launcher_two_workers(tmp_path):
+    """The cluster launcher (reference: scripts/cluster_train/paddle.py)
+    spawns 2 jax.distributed workers that train the SAME config over a
+    2-device global mesh (1 CPU device per process) and must agree on the
+    final loss bit-for-bit — sync data parallelism in lockstep, pserver-
+    free (distributed/launcher.py + worker.py + DataParallel)."""
+    config = tmp_path / "cfg.py"
+    config.write_text(
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "from paddle_tpu import layer as L, data_type as dt, activation as A\n"
+        "from paddle_tpu import optimizer as opt\n"
+        "batch_size = 16\n"
+        "def cost():\n"
+        "    x = L.data(name='x', type=dt.dense_vector(6))\n"
+        "    y = L.data(name='y', type=dt.integer_value(3))\n"
+        "    h = L.fc(input=x, size=12, act=A.Tanh())\n"
+        "    out = L.fc(input=h, size=3)\n"
+        "    return L.classification_cost(input=out, label=y)\n"
+        "def optimizer():\n"
+        "    return opt.Momentum(learning_rate=0.1, momentum=0.9)\n"
+        "def train_reader():\n"
+        "    def reader():\n"
+        "        rng = np.random.RandomState(0)\n"
+        "        W = rng.randn(6, 3)\n"
+        "        for _ in range(96):\n"
+        "            x = rng.randn(6).astype(np.float32)\n"
+        "            yield x, int(np.argmax(x @ W))\n"
+        "    return reader\n")
+
+    sys.path.insert(0, REPO)
+    from paddle_tpu.distributed.launcher import launch_local_cluster
+
+    results = launch_local_cluster(
+        str(config), num_processes=2, num_passes=2,
+        env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+             "PADDLE_TPU_LOG_LEVEL": "WARNING"},
+        devices_per_process=1, timeout=540)
+    assert len(results) == 2
+    for r in results:
+        assert r["processes"] == 2
+        assert r["global_devices"] == 2
+        assert r["final_cost"] < r["first_cost"]

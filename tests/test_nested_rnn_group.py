@@ -179,3 +179,37 @@ def test_mixed_flat_and_nested_inlinks():
         add = 1.0 if i == 0 else 2.0
         for j, sub in enumerate(subs):
             np.testing.assert_allclose(arr[i, j], sub[-1] + add, rtol=1e-6)
+
+
+def test_image_layer_inside_recurrent_step():
+    """An image layer as a recurrent-group step output: step outputs are
+    NHWC-resident ImageValues since round 3 and must be materialized for
+    lax.scan (regression: rnn_group scan body pytree handling)."""
+    import jax
+    import numpy as np
+    import paddle_tpu.layer as L
+    from paddle_tpu import activation as A, data_type as dt
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.topology import Topology
+
+    reset_name_counters()
+    xs = L.data(name="xs", type=dt.dense_vector_sequence(2 * 4 * 4))
+
+    def step(x_t):
+        x_t.out_img_shape = (2, 4, 4)
+        c = L.img_conv(input=x_t, filter_size=3, num_filters=2, padding=1,
+                       act=A.Relu(), param_attr=L.ParamAttr(name="rc.w")
+                       if hasattr(L, "ParamAttr") else None)
+        return c
+
+    grp = L.recurrent_group(step=step, input=[xs])
+    out = L.last_seq(input=grp)
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    seqs = [rng.randn(l, 32).astype(np.float32) for l in (3, 5)]
+    feed = {"xs": SequenceBatch.from_sequences(seqs, max_len=6)}
+    vals, _ = topo.apply(params, feed, mode="test")
+    assert np.asarray(vals[out.name]).shape == (2, 32)
+    assert np.isfinite(np.asarray(vals[out.name])).all()
