@@ -221,7 +221,7 @@ def cmd_cluster_train(args):
             "device visibility) to set the parallel width")
     results = launch_local_cluster(
         args.config, args.num_processes, num_passes=args.num_passes,
-        config_args=args.config_args,
+        batch_size=args.batch_size, config_args=args.config_args,
         devices_per_process=args.devices_per_process)
     for r in results:
         print(json.dumps(r))
@@ -335,7 +335,10 @@ def main(argv=None):
     p.set_defaults(fn=cmd_merge_model)
 
     args = parser.parse_args(argv)
-    if getattr(args, "use_tpu", None) is not None:
+    if getattr(args, "use_tpu", None) is not None \
+            and args.fn is not cmd_cluster_train:
+        # the cluster launcher must NOT touch jax in the parent: device
+        # enumeration would lock the TPU runtime the workers need
         import paddle_tpu as paddle
 
         paddle.init(use_tpu=args.use_tpu)

@@ -47,7 +47,11 @@ def launch_local_cluster(config, num_processes, num_passes=1,
     if devices_per_process is not None:
         base_env["XLA_FLAGS"] = (
             "--xla_force_host_platform_device_count=%d" % devices_per_process)
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="paddle_tpu_cluster_")
     procs = []
+    streams = []
     for pid in range(num_processes):
         cmd = [sys.executable, "-m", "paddle_tpu.distributed.worker",
                "--config", str(config), "--process-id", str(pid),
@@ -58,16 +62,24 @@ def launch_local_cluster(config, num_processes, num_passes=1,
             cmd += ["--batch-size", str(batch_size)]
         if config_args:
             cmd += ["--config-args", config_args]
-        procs.append(subprocess.Popen(
-            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=base_env))
+        # log FILES, not pipes: a chatty worker (log_period=1) fills a 64KB
+        # pipe buffer and deadlocks long before the launcher drains it
+        out_f = open(os.path.join(workdir, "worker%d.out" % pid), "w+")
+        err_f = open(os.path.join(workdir, "worker%d.err" % pid), "w+")
+        streams.append((out_f, err_f))
+        procs.append(subprocess.Popen(cmd, stdout=out_f, stderr=err_f,
+                                      text=True, env=base_env))
     import time
+
+    def read_stream(f):
+        f.flush()
+        f.seek(0)
+        return f.read()
 
     # poll ALL workers: one crashed worker leaves its siblings blocked in a
     # collective forever — awaiting sequentially would burn the whole
     # timeout on the innocent process and report it as the failure
     deadline = time.time() + timeout
-    outputs = {}
     errors = []
     pending = dict(enumerate(procs))
     while pending and time.time() < deadline and not errors:
@@ -75,30 +87,38 @@ def launch_local_cluster(config, num_processes, num_passes=1,
             proc = pending[pid]
             if proc.poll() is None:
                 continue
-            out, err = proc.communicate()
             del pending[pid]
-            outputs[pid] = out
             if proc.returncode != 0:
                 errors.append("worker %d rc=%d: %s"
-                              % (pid, proc.returncode, err[-1500:]))
+                              % (pid, proc.returncode,
+                                 read_stream(streams[pid][1])[-1500:]))
         time.sleep(0.2)
     if pending:
+        sibling_failed = bool(errors)
         for pid, proc in pending.items():
             proc.kill()
-            proc.communicate()
-            if not errors:
-                errors.append("worker %d timed out" % pid)
-            else:
-                errors.append("worker %d killed (sibling failed)" % pid)
+            proc.wait()
+            errors.append("worker %d %s" % (
+                pid, "killed (sibling failed)" if sibling_failed
+                else "timed out"))
     if errors:
-        raise RuntimeError("cluster launch failed: %s" % "; ".join(errors))
+        raise RuntimeError("cluster launch failed: %s (logs: %s)"
+                           % ("; ".join(errors), workdir))
     results = []
-    for pid in sorted(outputs):
-        lines = [l for l in outputs[pid].splitlines()
+    for pid in range(num_processes):
+        out = read_stream(streams[pid][0])
+        lines = [l for l in out.splitlines()
                  if l.startswith("CLUSTER_RESULT ")]
         if not lines:
-            raise RuntimeError("worker %d printed no result" % pid)
+            raise RuntimeError("worker %d printed no result (logs: %s)"
+                               % (pid, workdir))
         results.append(json.loads(lines[-1][len("CLUSTER_RESULT "):]))
+    for out_f, err_f in streams:
+        out_f.close()
+        err_f.close()
+    import shutil
+
+    shutil.rmtree(workdir, ignore_errors=True)  # logs kept only on failure
     if any(r["final_cost"] is None for r in results):
         raise RuntimeError(
             "a worker trained zero batches (reader shorter than one "
