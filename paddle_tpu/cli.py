@@ -222,7 +222,8 @@ def cmd_cluster_train(args):
     results = launch_local_cluster(
         args.config, args.num_processes, num_passes=args.num_passes,
         batch_size=args.batch_size, config_args=args.config_args,
-        devices_per_process=args.devices_per_process)
+        devices_per_process=args.devices_per_process,
+        use_tpu=args.use_tpu)
     for r in results:
         print(json.dumps(r))
     return 0

@@ -32,7 +32,8 @@ def _free_port():
 
 def launch_local_cluster(config, num_processes, num_passes=1,
                          batch_size=None, config_args="", env=None,
-                         timeout=900, devices_per_process=None):
+                         timeout=900, devices_per_process=None,
+                         use_tpu=None):
     """Spawn ``num_processes`` workers on localhost and wait.
 
     Returns the list of per-worker result dicts (CLUSTER_RESULT lines).
@@ -62,6 +63,8 @@ def launch_local_cluster(config, num_processes, num_passes=1,
             cmd += ["--batch-size", str(batch_size)]
         if config_args:
             cmd += ["--config-args", config_args]
+        if use_tpu:  # forwarded to each worker; the parent never touches jax
+            cmd += ["--use-tpu"]
         # log FILES, not pipes: a chatty worker (log_period=1) fills a 64KB
         # pipe buffer and deadlocks long before the launcher drains it
         out_f = open(os.path.join(workdir, "worker%d.out" % pid), "w+")
@@ -69,6 +72,7 @@ def launch_local_cluster(config, num_processes, num_passes=1,
         streams.append((out_f, err_f))
         procs.append(subprocess.Popen(cmd, stdout=out_f, stderr=err_f,
                                       text=True, env=base_env))
+    import shutil
     import time
 
     def read_stream(f):
@@ -76,56 +80,60 @@ def launch_local_cluster(config, num_processes, num_passes=1,
         f.seek(0)
         return f.read()
 
-    # poll ALL workers: one crashed worker leaves its siblings blocked in a
-    # collective forever — awaiting sequentially would burn the whole
-    # timeout on the innocent process and report it as the failure
-    deadline = time.time() + timeout
-    errors = []
-    pending = dict(enumerate(procs))
-    while pending and time.time() < deadline and not errors:
-        for pid in list(pending):
-            proc = pending[pid]
-            if proc.poll() is None:
-                continue
-            del pending[pid]
-            if proc.returncode != 0:
-                errors.append("worker %d rc=%d: %s"
-                              % (pid, proc.returncode,
-                                 read_stream(streams[pid][1])[-1500:]))
-        time.sleep(0.2)
-    if pending:
-        sibling_failed = bool(errors)
-        for pid, proc in pending.items():
-            proc.kill()
-            proc.wait()
-            errors.append("worker %d %s" % (
-                pid, "killed (sibling failed)" if sibling_failed
-                else "timed out"))
-    if errors:
-        raise RuntimeError("cluster launch failed: %s (logs: %s)"
-                           % ("; ".join(errors), workdir))
-    results = []
-    for pid in range(num_processes):
-        out = read_stream(streams[pid][0])
-        lines = [l for l in out.splitlines()
-                 if l.startswith("CLUSTER_RESULT ")]
-        if not lines:
-            raise RuntimeError("worker %d printed no result (logs: %s)"
-                               % (pid, workdir))
-        results.append(json.loads(lines[-1][len("CLUSTER_RESULT "):]))
+    try:
+        # poll ALL workers: one crashed worker leaves its siblings blocked
+        # in a collective forever — awaiting sequentially would burn the
+        # whole timeout on the innocent process and report it as the failure
+        deadline = time.time() + timeout
+        errors = []
+        pending = dict(enumerate(procs))
+        while pending and time.time() < deadline and not errors:
+            for pid in list(pending):
+                proc = pending[pid]
+                if proc.poll() is None:
+                    continue
+                del pending[pid]
+                if proc.returncode != 0:
+                    errors.append("worker %d rc=%d: %s"
+                                  % (pid, proc.returncode,
+                                     read_stream(streams[pid][1])[-1500:]))
+            time.sleep(0.2)
+        if pending:
+            sibling_failed = bool(errors)
+            for pid, proc in pending.items():
+                proc.kill()
+                proc.wait()
+                errors.append("worker %d %s" % (
+                    pid, "killed (sibling failed)" if sibling_failed
+                    else "timed out"))
+        if errors:
+            raise RuntimeError("cluster launch failed: %s (logs: %s)"
+                               % ("; ".join(errors), workdir))
+        results = []
+        for pid in range(num_processes):
+            out = read_stream(streams[pid][0])
+            lines = [l for l in out.splitlines()
+                     if l.startswith("CLUSTER_RESULT ")]
+            if not lines:
+                raise RuntimeError("worker %d printed no result (logs: %s)"
+                                   % (pid, workdir))
+            results.append(json.loads(lines[-1][len("CLUSTER_RESULT "):]))
+        if any(r["final_cost"] is None for r in results):
+            raise RuntimeError(
+                "a worker trained zero batches (reader shorter than one "
+                "batch?): %s (logs: %s)" % (results, workdir))
+        finals = {round(r["final_cost"], 6) for r in results}
+        if len(finals) != 1:
+            raise RuntimeError(
+                "workers disagree on the final loss (sync-SGD lockstep "
+                "violated): %s (logs: %s)" % (sorted(finals), workdir))
+    except BaseException:
+        for out_f, err_f in streams:  # close but KEEP the logs for debugging
+            out_f.close()
+            err_f.close()
+        raise
     for out_f, err_f in streams:
         out_f.close()
         err_f.close()
-    import shutil
-
     shutil.rmtree(workdir, ignore_errors=True)  # logs kept only on failure
-    if any(r["final_cost"] is None for r in results):
-        raise RuntimeError(
-            "a worker trained zero batches (reader shorter than one "
-            "batch?): %s" % results)
-    finals = {round(r["final_cost"], 6) for r in results}
-    if len(finals) != 1:
-        raise RuntimeError(
-            "workers disagree on the final loss (sync-SGD lockstep "
-            "violated): %s" % sorted(finals))
     return results

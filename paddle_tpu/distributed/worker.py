@@ -32,7 +32,13 @@ def main(argv=None):
                     help="host:port of the jax.distributed coordinator")
     ap.add_argument("--num-passes", type=int, default=1)
     ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--use-tpu", action="store_true", default=False)
     args = ap.parse_args(argv)
+
+    if args.use_tpu:
+        import paddle_tpu as paddle
+
+        paddle.init(use_tpu=True)
 
     from paddle_tpu.distributed.multihost import initialize_multihost
 

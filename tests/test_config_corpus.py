@@ -125,3 +125,37 @@ def test_corpus_excluded_configs_documented():
     all_py = {f[:-3] for f in os.listdir(CFG_DIR) if f.endswith(".py")}
     excluded = all_py - set(OFFICIAL)
     assert excluded == {"test_crop", "test_config_parser_for_non_file_config"}
+
+
+# which official corpus configs contain closure-built layers (recurrent
+# groups) that are opaque to the proto interchange by design
+_OPAQUE_EXPECTED = {"test_rnn_group"}
+
+
+@pytest.mark.skipif(not os.path.isdir(CFG_DIR),
+                    reason="reference checkout not present")
+@pytest.mark.parametrize("name", OFFICIAL)
+def test_official_corpus_config_proto_roundtrip(name):
+    """Every corpus config must also survive the ModelConfig proto
+    interchange: serialize, rebuild WITHOUT re-executing the config, and
+    match parameter specs exactly (topology.py to_proto/from_proto).
+    Configs with recurrent-group step closures are opaque by design and
+    must say so in the proto."""
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.proto.interchange import opaque_layer_names
+    from paddle_tpu.topology import Topology
+
+    topo, _ = _build_config(name)
+    msg = topo.to_proto()
+    opaque = opaque_layer_names(msg)
+    if name in _OPAQUE_EXPECTED:
+        assert opaque, "%s should contain opaque (closure-built) layers" % name
+        return
+    assert not opaque, "unexpected opaque layers in %s: %s" % (name, opaque)
+    blob = msg.SerializeToString()
+    reset_name_counters()
+    topo2 = Topology.from_proto(blob)
+    specs1 = {n: tuple(s.shape) for n, s in topo.param_specs().items()}
+    specs2 = {n: tuple(s.shape) for n, s in topo2.param_specs().items()}
+    assert specs1 == specs2
+    assert [n.name for n in topo2.outputs] == list(msg.output_layer_names)
