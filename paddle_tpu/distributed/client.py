@@ -29,25 +29,33 @@ def spawn_coordinator(port, snapshot_path="", task_timeout=600.0,
          str(failure_max)],
         stderr=subprocess.PIPE)
     # wait for the listening line; surface startup failures (e.g. bind).
-    # poll stderr with a deadline — readline() alone could block forever on
-    # a wedged binary that emits nothing.
+    # Poll the RAW fd and split lines ourselves: selectors + buffered
+    # readline() lost "listening" whenever the recovery path emitted
+    # "recovered\nlistening\n" in one chunk — readline() returned the first
+    # line, the second sat in Python's buffer, and select() on the fd never
+    # fired again (the long-standing "coordinator did not start" flake).
     import selectors
 
+    fd = proc.stderr.fileno()
     sel = selectors.DefaultSelector()
-    sel.register(proc.stderr, selectors.EVENT_READ)
-    deadline = time.time() + 180  # 60s fired spuriously when the
-    # single-core host also runs the test suite (subprocess starvation)
+    sel.register(fd, selectors.EVENT_READ)
+    # generous deadline: the raw-fd fix removed the lost-line hang, but a
+    # 1-core host running the full test suite can still starve a fresh
+    # subprocess well past 60s
+    deadline = time.time() + 180
+    buf = b""
     try:
         while time.time() < deadline:
             if not sel.select(timeout=max(0.0, deadline - time.time())):
                 break  # deadline hit with no output
-            line = proc.stderr.readline().decode()
-            if "listening" in line:
-                return proc
-            if line == "" or proc.poll() is not None:  # EOF: process died
+            chunk = os.read(fd, 4096)
+            if chunk == b"":  # EOF: process died
                 raise RuntimeError(
-                    "coordinator failed to start on port %d (exit %s)"
-                    % (port, proc.poll()))
+                    "coordinator failed to start on port %d (exit %s): %s"
+                    % (port, proc.poll(), buf.decode(errors="replace")[-500:]))
+            buf += chunk
+            if b"listening" in buf:
+                return proc
             # other lines (e.g. "recovered") just precede "listening"
     finally:
         sel.close()

@@ -428,7 +428,10 @@ class Service {
       write_tasks(f, done_);
       f << "], \"failed\": [";
       write_tasks(f, failed_);
-      f << "]}\n";
+      // completeness marker, written LAST: recovery refuses any file
+      // without it (go/pserver checkpoints carried an MD5 for the same
+      // reason — detect external truncation/corruption, service.go:104)
+      f << "], \"eof\": 1}\n";
     }
     rename(tmp.c_str(), snapshot_path_.c_str());
   }
@@ -510,13 +513,45 @@ class Service {
         pos = j + 1;
       }
     };
-    auto top = parse_json(content);
-    pass_ = (int)top["pass"].num;
-    next_task_id_ = (int64_t)top["next_task_id"].num;
-    if (next_task_id_ < 1) next_task_id_ = 1;
-    load_queue("todo", &todo_);
-    load_queue("done", &done_);
-    load_queue("failed", &failed_);
+    // a malformed snapshot (external truncation/corruption — our own
+    // writes are tmp+rename atomic) must fail the start CLEANLY, like
+    // go/master's recover returning an error — neither std::terminate via
+    // an uncaught parser exception NOR a silent lenient parse that drops
+    // queued tasks. The "eof" marker is written last, so its absence
+    // proves the file is not a complete snapshot.
+    // legacy pre-marker snapshots ended in exactly "]}\n" (or "]}"), which
+    // truncation cannot produce — accept those so an upgrade restart does
+    // not discard intact state
+    bool legacy_complete = false;
+    {
+      std::string trimmed = content;
+      while (!trimmed.empty() &&
+             isspace((unsigned char)trimmed.back())) trimmed.pop_back();
+      legacy_complete = trimmed.size() >= 2 &&
+                        trimmed.compare(trimmed.size() - 2, 2, "]}") == 0;
+    }
+    if (content.find("\"eof\"") == std::string::npos && !legacy_complete) {
+      fprintf(stderr,
+              "[coordinator] FATAL: snapshot %s has no completeness marker "
+              "(truncated or foreign file); refusing to start with partial "
+              "state — repair or remove the file\n", snapshot_path_.c_str());
+      exit(1);
+    }
+    try {
+      auto top = parse_json(content);
+      pass_ = (int)top["pass"].num;
+      next_task_id_ = (int64_t)top["next_task_id"].num;
+      if (next_task_id_ < 1) next_task_id_ = 1;
+      load_queue("todo", &todo_);
+      load_queue("done", &done_);
+      load_queue("failed", &failed_);
+    } catch (const std::exception& e) {
+      fprintf(stderr,
+              "[coordinator] FATAL: snapshot %s is unreadable (%s); refusing "
+              "to start with partial state — repair or remove the file\n",
+              snapshot_path_.c_str(), e.what());
+      exit(1);
+    }
     fprintf(stderr, "[coordinator] recovered: pass=%d todo=%zu done=%zu\n",
             pass_, todo_.size(), done_.size());
   }
