@@ -1,0 +1,22 @@
+"""Dump the optimized HLO of a harness train step for fusion forensics."""
+import argparse, sys
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--out", default="/tmp/hlo.txt")
+    args = ap.parse_args()
+    from benchmark.harness import build_image_step
+    import jax
+    bundle = build_image_step(args.model, args.batch)
+    # bundle.step is carry->carry closure over jitted fn; trace+compile it
+    lowered = jax.jit(bundle.step).lower(bundle.carry)
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    open(args.out, "w").write(txt)
+    print("wrote %d bytes to %s" % (len(txt), args.out))
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,15 @@ def _use_benchmark_precision():
         flags.set_flag("matmul_precision", "default")
 
 
+def bench_slot_dtype():
+    """Optimizer moment-slot storage dtype for benchmark steps:
+    bfloat16 by default (halves the optimizer's HBM slot traffic — the
+    update is pure bandwidth on big CNNs; arithmetic stays f32, guarded by
+    the lockstep tolerance test in test_optimizers.py). Override with
+    PADDLE_TPU_SLOT_DTYPE=float32."""
+    return os.environ.get("PADDLE_TPU_SLOT_DTYPE", "bfloat16")
+
+
 def chain_slope_ms(step, carry, fetch, n1=10, n2=110):
     """step: carry -> carry (jitted; each call data-depends on the last);
     fetch: carry -> python scalar (host sync). Returns (ms_per_step, carry)."""
@@ -243,7 +252,8 @@ def build_rnn_step(batch, hidden, seqlen=100, dict_size=30000, emb=128,
     words, label, out, cost = graft._flagship(
         dict_size=dict_size, emb=emb, hidden=hidden, classes=classes)
     topo = Topology(cost)
-    optimizer = opt.Momentum(learning_rate=lr, momentum=0.9)
+    optimizer = opt.Momentum(learning_rate=lr, momentum=0.9,
+                             slot_dtype=bench_slot_dtype())
 
     def feed_of(data, lengths, labels):
         return {"word": SequenceBatch(data, lengths), "label": labels}
@@ -294,7 +304,8 @@ def build_image_step(model_name, batch, lr=0.01, dp_mesh=None):
     label = L.data(name="label", type=dt.integer_value(classes))
     cost = L.classification_cost(input=out, label=label)
     topo = Topology(cost)
-    optimizer = opt.Momentum(learning_rate=lr, momentum=0.9)
+    optimizer = opt.Momentum(learning_rate=lr, momentum=0.9,
+                             slot_dtype=bench_slot_dtype())
 
     def feed_of(images, labels):
         return {"image": images, "label": labels}

@@ -1,0 +1,68 @@
+"""Shared jax-profiler trace unpacking for the benchmark experiments.
+
+One place holds the trace-layout knowledge (pid/tid -> thread-name metadata
+map, "X" duration events, the "XLA Modules"/"XLA Ops" track names) so the
+experiment scripts can't drift apart on it.
+"""
+
+import collections
+import glob
+import gzip
+import json
+import shutil
+import tempfile
+
+
+class DeviceTrace:
+    """Parsed device-side durations from one profiler trace directory."""
+
+    def __init__(self, module_us, per_op_us, calls):
+        self.module_us = module_us    # total "XLA Modules" span time (us)
+        self.per_op_us = per_op_us    # Counter: op name -> total us
+        self.calls = calls            # Counter: op name -> #events
+
+    def module_ms_per(self, n):
+        return self.module_us / n / 1000.0 if self.module_us else None
+
+
+def capture(run_fn, sync_fn):
+    """Trace ``run_fn()`` (sync with ``sync_fn()`` before/after) and return
+    a DeviceTrace, or None if the backend produced no trace."""
+    import jax
+
+    sync_fn()
+    tmp = tempfile.mkdtemp(prefix="bench_trace_")
+    try:
+        jax.profiler.start_trace(tmp)
+        run_fn()
+        sync_fn()
+        jax.profiler.stop_trace()
+        files = glob.glob(tmp + "/**/*.trace.json.gz", recursive=True)
+        if not files:
+            return None
+        with gzip.open(files[0], "rt") as fh:
+            data = json.load(fh)
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    tracks = {}
+    for ev in data.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            tracks[(ev["pid"], ev["tid"])] = ev["args"].get("name")
+    module_us = 0.0
+    per_op = collections.Counter()
+    calls = collections.Counter()
+    for ev in data.get("traceEvents", []):
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        tname = tracks.get((ev.get("pid"), ev.get("tid"))) or ""
+        if tname == "XLA Modules":
+            module_us += ev["dur"]
+        elif tname == "XLA Ops":
+            per_op[ev["name"]] += ev["dur"]
+            calls[ev["name"]] += 1
+    return DeviceTrace(module_us, per_op, calls)

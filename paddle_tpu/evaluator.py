@@ -70,6 +70,36 @@ def classification_error(input, label, weight=None, name=None, top_k=1):
     return _mk_eval("classification_error", forward, inputs, name, _acc_add, result)
 
 
+def seq_classification_error(input, label, name=None):
+    """Whole-sequence classification error: a sequence counts as ONE error
+    if ANY of its frames is misclassified; the denominator is the number
+    of sequences (reference: SequenceClassificationErrorEvaluator,
+    gserver/evaluators/Evaluator.cpp:136-173 — per-sequence sum of the
+    frame-error vector, errCounter += (sum > 0))."""
+    inputs = [input, label]
+
+    def forward(params, values, ctx):
+        out, lab = values[0], values[1]
+        enforce(is_seq(out) and is_seq(lab),
+                "seq_classification_error expects sequence input AND label "
+                "(the reference requires sequenceStartPositions)")
+        x, y = data_of(out), data_of(lab).astype(jnp.int32)
+        wrong = (jnp.argmax(x, axis=-1).astype(jnp.int32) != y)
+        m = lab.mask(jnp.float32)
+        frame_errs = jnp.sum(wrong.astype(jnp.float32) * m, axis=-1)
+        live = (jnp.sum(m, axis=-1) > 0).astype(jnp.float32)
+        return {"wrong": jnp.sum((frame_errs > 0).astype(jnp.float32) * live),
+                "total": jnp.sum(live)}
+
+    def result(acc):
+        if not acc or acc["total"] == 0:
+            return 0.0
+        return float(acc["wrong"] / acc["total"])
+
+    return _mk_eval("seq_classification_error", forward, inputs, name,
+                    _acc_add, result)
+
+
 def sum_evaluator(input, weight=None, name=None):
     """Sum of input values (reference: SumEvaluator)."""
     inputs = [input] + ([weight] if weight is not None else [])
@@ -605,6 +635,7 @@ def classification_error_printer(input, label, name=None):
 
 # reference-DSL alias names (trainer_config_helpers/evaluators.py)
 classification_error_evaluator = classification_error
+seq_classification_error_evaluator = seq_classification_error
 auc_evaluator = auc
 pnpair_evaluator = pnpair
 precision_recall_evaluator = precision_recall

@@ -32,11 +32,51 @@ _name_counters = {}
 _creation_counter = itertools.count()
 
 
+# Auto-name templates aligned with the reference's wrap_name_default tags
+# (trainer_config_helpers/layers.py) so configs, checkpoints, and the
+# protostr cross-check (tests/test_config_corpus.py) agree on generated
+# layer names: e.g. img_conv -> "__conv_0__", pooling -> "__seq_pooling_0__".
+# Keys are OUR layer-type tags; anything absent keeps its tag verbatim.
+_REF_NAME_TAGS = {
+    "conv_layer": "conv",
+    "img_pool": "pool",
+    "batch_norm_layer": "batch_norm",
+    "img_cmrnorm": "crmnorm",
+    "embedding_layer": "embedding",
+    "classification_cost": "cost",
+    "square_error_cost": "mse_cost",
+    "huber_classification_cost": "huber_cost",
+    "grumemory": "gru",
+    "trans": "trans_layer",
+    "expand": "expand_layer",
+    "hsigmoid_layer": "hsigmoid",
+    "maxout": "maxout_layer",
+    "block_expand": "block_expand_layer",
+    "multiplex": "multiplex_layer",
+    "interpolation": "interpolation_layer",
+    "power": "power_layer",
+    "scaling": "scaling_layer",
+    "sum_to_one_norm": "sum_to_one_norm_layer",
+    "conv_shift": "conv_shift_layer",
+    "linear_comb": "linear_comb_layer",
+    "slope_intercept": "slope_intercept_layer",
+    "addto_layer": "addto",
+    "repeat": "repeat_layer",
+    "seq_concat": "seqconcat",
+    "seq_reshape": "seqreshape",
+    "pooling": "seq_pooling",
+    "sampling_id": "sampling_id_layer",
+    "bilinear_interp": "bilinear_interp_layer",
+    "ctc": "ctc_layer",
+}
+
+
 def auto_name(layer_type):
+    tag = _REF_NAME_TAGS.get(layer_type, layer_type)
     with _name_lock:
-        idx = _name_counters.get(layer_type, 0)
-        _name_counters[layer_type] = idx + 1
-    return "__%s_%d__" % (layer_type, idx)
+        idx = _name_counters.get(tag, 0)
+        _name_counters[tag] = idx + 1
+    return "__%s_%d__" % (tag, idx)
 
 
 def reset_name_counters():

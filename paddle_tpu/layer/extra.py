@@ -51,7 +51,9 @@ def crf(input, label, size=None, weight=None, param_attr=None, name=None,
             nll = nll * data_of(values[2]).reshape(nll.shape)
         return nll
 
-    return make_node("crf", forward, inputs, name=name, size=1,
+    # node.size follows the reference's convention (CRFLayer config:
+    # size = number of labels), not the scalar cost width
+    return make_node("crf", forward, inputs, name=name, size=size,
                      param_specs=[wspec], layer_attr=layer_attr)
 
 
@@ -94,7 +96,10 @@ def ctc(input, label, size=None, name=None, norm_by_times=False,
     raw scores get log_softmax."""
     enforce(blank == 0, "ctc: only blank=0 is supported (the reference's "
             "default convention; remap class ids so blank is 0)")
-    size = size or input.size
+    # default size = label dict size + blank, the reference config_parser
+    # CTCLayer derivation (protostr: ctc size 5001 for a 5000-label input)
+    size = size or (getattr(label, "size", 0) + 1 if label is not None
+                    else input.size)
     is_probs = getattr(input, "output_activation", None) == "softmax"
     inputs = [input, label]
 
@@ -112,7 +117,8 @@ def ctc(input, label, size=None, name=None, norm_by_times=False,
             nll = nll / jnp.maximum(scores.lengths.astype(nll.dtype), 1.0)
         return nll
 
-    return make_node("ctc", forward, inputs, name=name, size=1,
+    # node.size = num_classes + 1 (the reference CTCLayer config contract)
+    return make_node("ctc", forward, inputs, name=name, size=size,
                      layer_attr=layer_attr)
 
 

@@ -30,12 +30,27 @@ from paddle_tpu.utils.error import enforce
 @register_layer("lstmemory")
 def lstmemory(input, name=None, size=None, reverse=False, act=None,
               gate_act=None, state_act=None, bias_attr=None, param_attr=None,
-              use_peephole=False, layer_attr=None):
+              use_peephole=None, gate_bias_attr="merged", layer_attr=None):
     """LSTM over a pre-projected sequence (input.size == 4*size).
 
     reference: LstmLayer.cpp:LstmLayer (project_input done by prior layer);
     act = cell-output activation (default tanh), gate_act sigmoid,
     state_act candidate/cell activation (default tanh).
+
+    Bias layout is the reference's 7*size (LstmLayer.cpp:32): 4*size gate
+    biases followed by the three peephole check vectors (checkI/checkF/
+    checkO at offsets 4/5/6*size — LstmLayer.cpp:59-61), and like the
+    reference the peephole connections are ACTIVE whenever the layer has a
+    bias. ``bias_attr=False`` gives the plain (bias-free, peephole-free)
+    cell; ``use_peephole=False`` forces a legacy 4*size bias without
+    peepholes.
+
+    ``gate_bias_attr`` other than the default "merged" selects the
+    recurrent-group SPLIT parameterization (reference networks.py
+    lstmemory_group -> lstmemory_unit): the 4*size gate bias is its own
+    parameter (the group's in-step mixed-layer bias, input_proj_bias_attr;
+    False = none) and ``bias_attr`` names the 3*size peephole-check
+    parameter of LstmStepLayer (config_parser LstmStepLayer bias).
     """
     size = size or input.size // 4
     enforce(input.size == 4 * size, "lstmemory input.size must be 4*size")
@@ -43,12 +58,25 @@ def lstmemory(input, name=None, size=None, reverse=False, act=None,
 
     name = name or auto_name("lstmemory")
     wspec = weight_spec(name, 0, (size, 4 * size), param_attr, fan_in=size)
-    bspec = bias_spec(name, (4 * size,), bias_attr)
-    pspec = (
-        weight_spec(name + ".peephole", 1, (3 * size,), param_attr, fan_in=size)
-        if use_peephole
-        else None
-    )
+    split = gate_bias_attr != "merged"
+    peephole = use_peephole is not False  # reference default: on with bias
+    if split:
+        gspec = bias_spec(name + "_proj", (4 * size,), gate_bias_attr)
+        bspec = bias_spec(name, (3 * size,), bias_attr) if peephole else None
+        if bspec is None:
+            enforce(use_peephole is not True,
+                    "lstmemory: use_peephole=True needs a bias parameter to "
+                    "hold the check vectors — bias_attr=False contradicts it")
+            peephole = False
+    else:
+        gspec = None
+        bspec = bias_spec(name, ((7 if peephole else 4) * size,), bias_attr)
+        if bspec is None:
+            enforce(use_peephole is not True,
+                    "lstmemory: use_peephole=True needs a bias parameter to "
+                    "hold the check vectors (the reference's 7*size bias, "
+                    "LstmLayer.cpp:32) — bias_attr=False contradicts it")
+            peephole = False  # no bias parameter -> no check vectors
     g_name = to_activation(gate_act or "sigmoid").name
     s_name = to_activation(state_act or "tanh").name
     o_name = to_activation(act or "tanh").name
@@ -62,8 +90,17 @@ def lstmemory(input, name=None, size=None, reverse=False, act=None,
         x = values[0]
         enforce(is_seq(x), "lstmemory expects a sequence input")
         gates = x.data
-        if bspec is not None:
-            gates = gates + params[bspec.name]
+        w_peep = None
+        if split:
+            if gspec is not None:
+                gates = gates + params[gspec.name]
+            if bspec is not None:
+                w_peep = params[bspec.name]
+        elif bspec is not None:
+            bias = params[bspec.name]
+            gates = gates + bias[: 4 * size]
+            if peephole:
+                w_peep = bias[4 * size:]
         h_seq, _ = rnn_ops.lstm_scan(
             gates,
             x.mask(gates.dtype),
@@ -73,14 +110,14 @@ def lstmemory(input, name=None, size=None, reverse=False, act=None,
             gate_act=g_act,
             state_act=s_act,
             reverse=reverse,
-            use_peephole=use_peephole,
-            w_peep=params[pspec.name] if pspec else None,
+            use_peephole=peephole,
+            w_peep=w_peep,
             standard_acts=standard_acts,
             out_act=o_act,
         )
         return SequenceBatch(h_seq, x.lengths)
 
-    specs = [s for s in (wspec, bspec, pspec) if s is not None]
+    specs = [s for s in (wspec, gspec, bspec) if s is not None]
     return make_node("lstmemory", forward, [input], name=name, size=size,
                      param_specs=specs, layer_attr=layer_attr)
 

@@ -394,8 +394,9 @@ def sampling_id(input, name=None, layer_attr=None):
         logits = jnp.log(jnp.maximum(x, 1e-20))
         return jax.random.categorical(ctx.next_rng(), logits, axis=-1).astype(jnp.int32)
 
-    return make_node("sampling_id", forward, [input], name=name, size=1,
-                     layer_attr=layer_attr)
+    # reference SamplingIdLayer keeps size = input size in its config
+    return make_node("sampling_id", forward, [input], name=name,
+                     size=input.size, layer_attr=layer_attr)
 
 
 @register_layer("eos_id")

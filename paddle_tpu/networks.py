@@ -7,6 +7,7 @@ sequence_conv_pool (text conv), vgg_16_network, simple_img_conv_pool).
 
 from paddle_tpu import activation as act_mod
 from paddle_tpu import layer as L
+from paddle_tpu.graph import auto_name
 from paddle_tpu import pooling as pool_mod
 from paddle_tpu.utils.error import enforce
 
@@ -51,9 +52,10 @@ def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
     """fc (4*size projection) + lstmemory (reference: simple_lstm,
     trainer_config_helpers/networks.py; mixed_layer_attr/lstm_cell_attr
     are the v1 ExtraAttrs of the two sub-layers)."""
+    name = name or auto_name("lstm")  # ref wrap_name_default("lstm")
     proj = L.fc(input=input, size=size * 4, act=None, bias_attr=False,
                 param_attr=mat_param_attr, layer_attr=mixed_layer_attr,
-                name="%s_transform" % name if name else None)
+                name="%s_transform" % name)
     return L.lstmemory(input=proj, size=size, reverse=reverse, act=act,
                        gate_act=gate_act, state_act=state_act,
                        bias_attr=bias_param_attr, param_attr=inner_param_attr,
@@ -93,6 +95,7 @@ def simple_gru(input, size, name=None, reverse=False, mat_param_attr=None,
     """fc (3*size projection) + grumemory. Accepts both this framework's
     arg names and the v1 DSL's (reference: networks.py simple_gru —
     mixed_param_attr/gru_param_attr naming)."""
+    name = name or auto_name("simple_gru")  # reference wrap_name_default
     mat_param_attr = mixed_param_attr or mat_param_attr
     inner_param_attr = gru_param_attr or inner_param_attr
     bias_param_attr = gru_bias_attr if gru_bias_attr is not None \
@@ -101,7 +104,7 @@ def simple_gru(input, size, name=None, reverse=False, mat_param_attr=None,
         else False
     proj = L.fc(input=input, size=size * 3, act=None, bias_attr=proj_bias,
                 param_attr=mat_param_attr, layer_attr=mixed_layer_attr,
-                name="%s_transform" % name if name else None)
+                name="%s_transform" % name)
     return L.grumemory(input=proj, size=size, reverse=reverse, act=act,
                        gate_act=gate_act, bias_attr=bias_param_attr,
                        param_attr=inner_param_attr, layer_attr=gru_layer_attr,
@@ -146,9 +149,11 @@ def lstmemory_group(input, size=None, name=None, reverse=False,
     lstmemory — a Python-level per-step subgraph would defeat XLA fusion —
     so the group attrs map onto the fused layer (docs/DELTAS.md)."""
     size = size or input.size // 4
+    name = name or auto_name("lstm_group")  # ref wrap_name_default
     return L.lstmemory(input=input, size=size, reverse=reverse, act=act,
                        gate_act=gate_act, state_act=state_act,
                        bias_attr=lstm_bias_attr, param_attr=param_attr,
+                       gate_bias_attr=input_proj_bias_attr,
                        layer_attr=lstm_layer_attr, name=name)
 
 
@@ -158,6 +163,7 @@ def gru_group(input, size=None, name=None, reverse=False, param_attr=None,
     """GRU over a pre-projected sequence (reference: networks.py gru_group;
     same TPU-native delta as :func:`lstmemory_group`)."""
     size = size or input.size // 3
+    name = name or auto_name("gru_group")  # ref wrap_name_default
     return L.grumemory(input=input, size=size, reverse=reverse, act=act,
                        gate_act=gate_act, bias_attr=gru_bias_attr,
                        param_attr=param_attr, layer_attr=gru_layer_attr,

@@ -333,6 +333,17 @@ def cross_map_norm_auto(x_nhwc, size, scale, power):
     if c > 1024:
         return cross_map_norm(x_nhwc, size, scale, power)
     alpha = scale / size
+    if x_nhwc.dtype == jnp.bfloat16:
+        # keep the big [B*H*W, C] operands in bf16 (the f32 spelling made
+        # the x^2 pass + band matmuls the largest backward dots in the
+        # AlexNet profile — 148MB f32 intermediates at conv1); the dot
+        # still ACCUMULATES f32, and base/power run f32 per element
+        x2 = x_nhwc * x_nhwc
+        band = jnp.asarray(_lrn_band(c, size), jnp.bfloat16)
+        s = lax.dot(x2.reshape(-1, c), band,
+                    preferred_element_type=jnp.float32).reshape(x_nhwc.shape)
+        base = 1.0 + alpha * s
+        return x_nhwc * (base ** (-power)).astype(x_nhwc.dtype)
     # f32 accumulation minimum; f64 respected (the checkgrad harness)
     ctype = jnp.promote_types(x_nhwc.dtype, jnp.float32)
     x2 = x_nhwc.astype(ctype) ** 2

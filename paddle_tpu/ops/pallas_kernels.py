@@ -141,7 +141,7 @@ def lstm_mode(batch, hidden, dtype):
 # LSTM forward — resident
 # ======================================================================
 
-def _lstm_fwd_kernel(gates_ref, mask_ref, w_ref, h0_ref, c0_ref,
+def _lstm_fwd_kernel(gates_ref, mask_ref, w_ref, peep_ref, h0_ref, c0_ref,
                      hseq_ref, cseq_ref, h_scr, c_scr):
     t = pl.program_id(0)
     dt = hseq_ref.dtype
@@ -157,11 +157,16 @@ def _lstm_fwd_kernel(gates_ref, mask_ref, w_ref, h0_ref, c0_ref,
                                      preferred_element_type=jnp.float32,
                                      precision=_dot_precision(h_prev.dtype))
     hidden = h_prev.shape[-1]
-    i = _sigmoid(z[:, :hidden])
-    f = _sigmoid(z[:, hidden:2 * hidden])
+    # peephole checks (reference hl_lstm_ops.cuh:61-64): i/f gates see
+    # c_{t-1}, o gate sees c_t; zero rows = plain LSTM, exactly
+    pi = peep_ref[0:1, :]
+    pf = peep_ref[1:2, :]
+    po = peep_ref[2:3, :]
+    i = _sigmoid(z[:, :hidden] + c_prev * pi)
+    f = _sigmoid(z[:, hidden:2 * hidden] + c_prev * pf)
     g = jnp.tanh(z[:, 2 * hidden:3 * hidden])
-    o = _sigmoid(z[:, 3 * hidden:])
     c_new = f * c_prev + i * g
+    o = _sigmoid(z[:, 3 * hidden:] + c_new * po)
     h_new = o * jnp.tanh(c_new)
     m = mask_ref[0]
     h = jnp.where(m > 0, h_new.astype(dt), h_prev)
@@ -172,7 +177,7 @@ def _lstm_fwd_kernel(gates_ref, mask_ref, w_ref, h0_ref, c0_ref,
     cseq_ref[0] = c.astype(dt)
 
 
-def _lstm_fwd_resident(gates_tm, mask_tm, w_rec, h0, c0):
+def _lstm_fwd_resident(gates_tm, mask_tm, w_rec, peep, h0, c0):
     t, b, g4 = gates_tm.shape
     hidden = g4 // 4
     dt = gates_tm.dtype
@@ -185,6 +190,8 @@ def _lstm_fwd_resident(gates_tm, mask_tm, w_rec, h0, c0):
             pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((hidden, g4), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, hidden), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((b, hidden), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
@@ -206,7 +213,7 @@ def _lstm_fwd_resident(gates_tm, mask_tm, w_rec, h0, c0):
             pltpu.VMEM((b, hidden), jnp.float32),
         ],
         interpret=_interpret(),
-    )(gates_tm, mask_tm[..., None], w_rec, h0, c0)
+    )(gates_tm, mask_tm[..., None], w_rec, peep, h0, c0)
 
 
 # ======================================================================
@@ -232,8 +239,9 @@ def _gate_unblocked(x_blk, hidden):
     return x.reshape(lead + (4 * hidden,))
 
 
-def _lstm_fwd_tiled_kernel(gates_ref, mask_ref, w_ref, h0_ref, c0_ref,
-                           hseq_ref, cseq_ref, hprev_scr, hnext_scr, c_scr):
+def _lstm_fwd_tiled_kernel(gates_ref, mask_ref, w_ref, peep_ref, h0_ref,
+                           c0_ref, hseq_ref, cseq_ref, hprev_scr, hnext_scr,
+                           c_scr):
     t = pl.program_id(0)
     j = pl.program_id(1)
     nj = pl.num_programs(1)
@@ -249,12 +257,15 @@ def _lstm_fwd_tiled_kernel(gates_ref, mask_ref, w_ref, h0_ref, c0_ref,
     z = _f32(gates_ref[0, 0]) + jnp.dot(h_prev_full, w_ref[0],
                                         preferred_element_type=jnp.float32,
                                         precision=_dot_precision(h_prev_full.dtype))
-    i = _sigmoid(z[:, :_BLK])
-    f = _sigmoid(z[:, _BLK:2 * _BLK])
-    g = jnp.tanh(z[:, 2 * _BLK:3 * _BLK])
-    o = _sigmoid(z[:, 3 * _BLK:])
     c_prev = c_scr[:, sl]
+    pi = peep_ref[0, 0:1, :]
+    pf = peep_ref[0, 1:2, :]
+    po = peep_ref[0, 2:3, :]
+    i = _sigmoid(z[:, :_BLK] + c_prev * pi)
+    f = _sigmoid(z[:, _BLK:2 * _BLK] + c_prev * pf)
+    g = jnp.tanh(z[:, 2 * _BLK:3 * _BLK])
     c_new = f * c_prev + i * g
+    o = _sigmoid(z[:, 3 * _BLK:] + c_new * po)
     h_new = o * jnp.tanh(c_new)
     m = mask_ref[0]
     h = jnp.where(m > 0, h_new.astype(dt), hprev_scr[:, sl])
@@ -269,7 +280,13 @@ def _lstm_fwd_tiled_kernel(gates_ref, mask_ref, w_ref, h0_ref, c0_ref,
         hprev_scr[:] = hnext_scr[:]
 
 
-def _lstm_fwd_tiled(gates_tm, mask_tm, w_rec, h0, c0):
+def _peep_blocked(peep, hidden):
+    """[3, H] -> [NJ, 3, BLK] so tile j loads its hidden-column slice."""
+    nj = hidden // _BLK
+    return jnp.moveaxis(peep.reshape(3, nj, _BLK), 1, 0)
+
+
+def _lstm_fwd_tiled(gates_tm, mask_tm, w_rec, peep, h0, c0):
     t, b, g4 = gates_tm.shape
     hidden = g4 // 4
     nj = hidden // _BLK
@@ -288,6 +305,8 @@ def _lstm_fwd_tiled(gates_tm, mask_tm, w_rec, h0, c0):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, hidden, 4 * _BLK), lambda i, j: (j, 0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3, _BLK), lambda i, j: (j, 0, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((b, hidden), lambda i, j: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((b, hidden), lambda i, j: (0, 0),
@@ -309,22 +328,24 @@ def _lstm_fwd_tiled(gates_tm, mask_tm, w_rec, h0, c0):
             pltpu.VMEM((b, hidden), jnp.float32),
         ],
         interpret=_interpret(),
-    )(gates_blocked, mask_tm[..., None], w_blocked, h0, c0)
+    )(gates_blocked, mask_tm[..., None], w_blocked,
+      _peep_blocked(peep, hidden), h0, c0)
 
 
-def _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0, mode):
+def _lstm_fwd(gates_tm, mask_tm, w_rec, peep, h0, c0, mode):
     if mode == "tiled":
-        return _lstm_fwd_tiled(gates_tm, mask_tm, w_rec, h0, c0)
-    return _lstm_fwd_resident(gates_tm, mask_tm, w_rec, h0, c0)
+        return _lstm_fwd_tiled(gates_tm, mask_tm, w_rec, peep, h0, c0)
+    return _lstm_fwd_resident(gates_tm, mask_tm, w_rec, peep, h0, c0)
 
 
 # ======================================================================
 # LSTM backward — resident
 # ======================================================================
 
-def _lstm_bwd_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
-                     cseq_ref, dh_seq_ref, dhf_ref, dcf_ref,
-                     dgates_ref, dh0_ref, dc0_ref, dh_scr, dc_scr):
+def _lstm_bwd_kernel(gates_ref, mask_ref, w_ref, peep_ref, hprev_ref,
+                     cprev_ref, cseq_ref, dh_seq_ref, dhf_ref, dcf_ref,
+                     dgates_ref, dh0_ref, dc0_ref, dpeep_ref,
+                     dh_scr, dc_scr):
     k = pl.program_id(0)          # 0 .. T-1, processing t = T-1-k
     dt = dgates_ref.dtype
 
@@ -332,6 +353,7 @@ def _lstm_bwd_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
     def _():
         dh_scr[:] = _f32(dhf_ref[:])
         dc_scr[:] = _f32(dcf_ref[:])
+        dpeep_ref[:] = jnp.zeros_like(dpeep_ref)
 
     h_prev = hprev_ref[0]
     c_prev = _f32(cprev_ref[0])
@@ -339,10 +361,14 @@ def _lstm_bwd_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
                                      preferred_element_type=jnp.float32,
                                      precision=_dot_precision(h_prev.dtype))
     hidden = h_prev.shape[-1]
-    i = _sigmoid(z[:, :hidden])
-    f = _sigmoid(z[:, hidden:2 * hidden])
+    pi = peep_ref[0:1, :]
+    pf = peep_ref[1:2, :]
+    po = peep_ref[2:3, :]
+    i = _sigmoid(z[:, :hidden] + c_prev * pi)
+    f = _sigmoid(z[:, hidden:2 * hidden] + c_prev * pf)
     g = jnp.tanh(z[:, 2 * hidden:3 * hidden])
-    o = _sigmoid(z[:, 3 * hidden:])
+    c_new = f * c_prev + i * g   # unmasked c_t (== cseq at live steps)
+    o = _sigmoid(z[:, 3 * hidden:] + c_new * po)
     tc = jnp.tanh(_f32(cseq_ref[0]))   # tanh(c_t)
 
     m = mask_ref[0]
@@ -350,20 +376,27 @@ def _lstm_bwd_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
     dc_tot = dc_scr[:]
     dh_eff = jnp.where(m > 0, dh_tot, 0.0)
     do = dh_eff * tc
-    dc_eff = jnp.where(m > 0, dc_tot, 0.0) + dh_eff * o * (1.0 - tc * tc)
+    dzo = do * o * (1.0 - o)
+    # o's peephole reads c_t: its grad feeds back into dc (hl_lstm_ops
+    # backward: grad.checkOg path)
+    dc_eff = (jnp.where(m > 0, dc_tot, 0.0)
+              + dh_eff * o * (1.0 - tc * tc) + dzo * po)
     dzi = dc_eff * g * i * (1.0 - i)
     dzf = dc_eff * c_prev * f * (1.0 - f)
     dzg = dc_eff * i * (1.0 - g * g)
-    dzo = do * o * (1.0 - o)
     dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)
     dgates_ref[0] = dz.astype(dt)
     dh_prev = jnp.where(m > 0, 0.0, dh_tot) + jnp.dot(
         dz.astype(w_ref.dtype), w_ref[:].T,
         preferred_element_type=jnp.float32,
         precision=_dot_precision(w_ref.dtype))
-    dc_prev = dc_eff * f + jnp.where(m > 0, 0.0, dc_tot)
+    dc_prev = (dc_eff * f + dzi * pi + dzf * pf
+               + jnp.where(m > 0, 0.0, dc_tot))
     dh_scr[:] = dh_prev
     dc_scr[:] = dc_prev
+    dpeep_ref[0:1, :] += jnp.sum(dzi * c_prev, axis=0, keepdims=True)
+    dpeep_ref[1:2, :] += jnp.sum(dzf * c_prev, axis=0, keepdims=True)
+    dpeep_ref[2:3, :] += jnp.sum(dzo * c_new, axis=0, keepdims=True)
 
     @pl.when(k == pl.num_programs(0) - 1)
     def _():
@@ -371,8 +404,8 @@ def _lstm_bwd_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
         dc0_ref[:] = dc_prev.astype(dc0_ref.dtype)
 
 
-def _lstm_bwd_resident(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
-                       dh_seq_tm, dh_f, dc_f):
+def _lstm_bwd_resident(gates_tm, mask_tm, w_rec, peep, hprev_tm, cprev_tm,
+                       cseq_tm, dh_seq_tm, dh_f, dc_f):
     t, b, g4 = gates_tm.shape
     hidden = g4 // 4
     dt = gates_tm.dtype
@@ -385,6 +418,7 @@ def _lstm_bwd_resident(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
             pl.BlockSpec((1, b, g4), rev, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, b, 1), rev, memory_space=pltpu.VMEM),
             pl.BlockSpec((hidden, g4), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, hidden), fixed, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, b, hidden), rev, memory_space=pltpu.VMEM),
@@ -396,18 +430,20 @@ def _lstm_bwd_resident(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
             pl.BlockSpec((1, b, g4), rev, memory_space=pltpu.VMEM),
             pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
             pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, hidden), fixed, memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((t, b, g4), dt),
             jax.ShapeDtypeStruct((b, hidden), dt),
             jax.ShapeDtypeStruct((b, hidden), dt),
+            jax.ShapeDtypeStruct((3, hidden), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((b, hidden), jnp.float32),
             pltpu.VMEM((b, hidden), jnp.float32),
         ],
         interpret=_interpret(),
-    )(gates_tm, mask_tm[..., None], w_rec, hprev_tm, cprev_tm, cseq_tm,
+    )(gates_tm, mask_tm[..., None], w_rec, peep, hprev_tm, cprev_tm, cseq_tm,
       dh_seq_tm, dh_f, dc_f)
 
 
@@ -415,9 +451,9 @@ def _lstm_bwd_resident(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
 # LSTM backward — tiled
 # ======================================================================
 
-def _lstm_bwd_tiled_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
-                           cseq_ref, dh_seq_ref, dhf_ref, dcf_ref,
-                           dgates_ref, dh0_ref, dc0_ref,
+def _lstm_bwd_tiled_kernel(gates_ref, mask_ref, w_ref, peep_ref, hprev_ref,
+                           cprev_ref, cseq_ref, dh_seq_ref, dhf_ref, dcf_ref,
+                           dgates_ref, dh0_ref, dc0_ref, dpeep_ref,
                            dhc_scr, dhn_scr, dc_scr):
     k = pl.program_id(0)
     j = pl.program_id(1)
@@ -430,28 +466,37 @@ def _lstm_bwd_tiled_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
     def _():
         dhc_scr[:] = _f32(dhf_ref[:])
         dc_scr[:] = _f32(dcf_ref[:])
+        # dpeep is a full-width fixed-index output: its block never moves,
+        # so it stays VMEM-resident across the whole grid (the dh0/dc0
+        # pattern) and per-step slices accumulate into it
+        dpeep_ref[:] = jnp.zeros_like(dpeep_ref)
 
     h_prev_full = hprev_ref[0]
     z = _f32(gates_ref[0, 0]) + jnp.dot(h_prev_full, w_ref[0],
                                         preferred_element_type=jnp.float32,
                                         precision=_dot_precision(h_prev_full.dtype))
-    i = _sigmoid(z[:, :_BLK])
-    f = _sigmoid(z[:, _BLK:2 * _BLK])
-    g = jnp.tanh(z[:, 2 * _BLK:3 * _BLK])
-    o = _sigmoid(z[:, 3 * _BLK:])
-    tc = jnp.tanh(_f32(cseq_ref[0]))
     c_prev = _f32(cprev_ref[0])
+    pi = peep_ref[0, 0:1, :]
+    pf = peep_ref[0, 1:2, :]
+    po = peep_ref[0, 2:3, :]
+    i = _sigmoid(z[:, :_BLK] + c_prev * pi)
+    f = _sigmoid(z[:, _BLK:2 * _BLK] + c_prev * pf)
+    g = jnp.tanh(z[:, 2 * _BLK:3 * _BLK])
+    c_new = f * c_prev + i * g
+    o = _sigmoid(z[:, 3 * _BLK:] + c_new * po)
+    tc = jnp.tanh(_f32(cseq_ref[0]))
 
     m = mask_ref[0]
     dh_tot = _f32(dh_seq_ref[0]) + dhc_scr[:, sl]
     dc_tot = dc_scr[:, sl]
     dh_eff = jnp.where(m > 0, dh_tot, 0.0)
     do = dh_eff * tc
-    dc_eff = jnp.where(m > 0, dc_tot, 0.0) + dh_eff * o * (1.0 - tc * tc)
+    dzo = do * o * (1.0 - o)
+    dc_eff = (jnp.where(m > 0, dc_tot, 0.0)
+              + dh_eff * o * (1.0 - tc * tc) + dzo * po)
     dzi = dc_eff * g * i * (1.0 - i)
     dzf = dc_eff * c_prev * f * (1.0 - f)
     dzg = dc_eff * i * (1.0 - g * g)
-    dzo = do * o * (1.0 - o)
     dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)
     dgates_ref[0, 0] = dz.astype(dt)
 
@@ -470,9 +515,13 @@ def _lstm_bwd_tiled_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
         dhn_scr[:] += contrib
 
     # block-diagonal terms land in this block's columns only: the masked
-    # passthrough of dh, and the dc carry
+    # passthrough of dh, and the dc carry (incl. the i/f peephole feedback)
     dhn_scr[:, sl] += jnp.where(m > 0, 0.0, dh_tot)
-    dc_scr[:, sl] = dc_eff * f + jnp.where(m > 0, 0.0, dc_tot)
+    dc_scr[:, sl] = (dc_eff * f + dzi * pi + dzf * pf
+                     + jnp.where(m > 0, 0.0, dc_tot))
+    dpeep_ref[0:1, sl] += jnp.sum(dzi * c_prev, axis=0, keepdims=True)
+    dpeep_ref[1:2, sl] += jnp.sum(dzf * c_prev, axis=0, keepdims=True)
+    dpeep_ref[2:3, sl] += jnp.sum(dzo * c_new, axis=0, keepdims=True)
 
     @pl.when(j == nj - 1)
     def _():
@@ -484,8 +533,8 @@ def _lstm_bwd_tiled_kernel(gates_ref, mask_ref, w_ref, hprev_ref, cprev_ref,
         dc0_ref[:] = dc_scr[:].astype(dc0_ref.dtype)
 
 
-def _lstm_bwd_tiled(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
-                    dh_seq_tm, dh_f, dc_f):
+def _lstm_bwd_tiled(gates_tm, mask_tm, w_rec, peep, hprev_tm, cprev_tm,
+                    cseq_tm, dh_seq_tm, dh_f, dc_f):
     t, b, g4 = gates_tm.shape
     hidden = g4 // 4
     nj = hidden // _BLK
@@ -497,13 +546,15 @@ def _lstm_bwd_tiled(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
     rev3 = lambda k, j: (t - 1 - k, 0, 0)      # noqa: E731
     revb = lambda k, j: (t - 1 - k, 0, j)      # noqa: E731
     fixed = lambda k, j: (0, 0)                # noqa: E731
-    dgates_blocked, dh0, dc0 = pl.pallas_call(
+    dgates_blocked, dh0, dc0, dpeep = pl.pallas_call(
         _lstm_bwd_tiled_kernel,
         grid=(t, nj),
         in_specs=[
             pl.BlockSpec((1, 1, b, 4 * _BLK), rev4, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, b, 1), rev3, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, hidden, 4 * _BLK), lambda k, j: (j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3, _BLK), lambda k, j: (j, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, b, hidden), rev3, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, b, _BLK), revb, memory_space=pltpu.VMEM),
@@ -516,11 +567,13 @@ def _lstm_bwd_tiled(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
             pl.BlockSpec((1, 1, b, 4 * _BLK), rev4, memory_space=pltpu.VMEM),
             pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
             pl.BlockSpec((b, hidden), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, hidden), fixed, memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((t, nj, b, 4 * _BLK), dt),
             jax.ShapeDtypeStruct((b, hidden), dt),
             jax.ShapeDtypeStruct((b, hidden), dt),
+            jax.ShapeDtypeStruct((3, hidden), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((b, hidden), jnp.float32),
@@ -528,10 +581,11 @@ def _lstm_bwd_tiled(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
             pltpu.VMEM((b, hidden), jnp.float32),
         ],
         interpret=_interpret(),
-    )(gates_blocked, mask_tm[..., None], w_blocked, hprev_tm, cprev_tm,
+    )(gates_blocked, mask_tm[..., None], w_blocked,
+      _peep_blocked(peep, hidden), hprev_tm, cprev_tm,
       cseq_tm, dh_seq_tm, dh_f, dc_f)
     dgates = _gate_unblocked(jnp.moveaxis(dgates_blocked, 1, 2), hidden)
-    return dgates, dh0, dc0
+    return dgates, dh0, dc0, dpeep
 
 
 # ======================================================================
@@ -539,41 +593,55 @@ def _lstm_bwd_tiled(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm, cseq_tm,
 # ======================================================================
 
 @jax.custom_vjp
-def lstm_fused(gates_tm, mask_tm, w_rec, h0, c0):
+def lstm_fused(gates_tm, mask_tm, w_rec, h0, c0, w_peep=None):
     """Fused masked LSTM scan (standard gates: i,f = sigmoid; g = tanh;
-    h = o * tanh(c)). gates_tm [T, B, 4H] already holds W_in·x + b.
-    Returns (h_seq_tm [T, B, H], h_f, c_f). Masked steps copy state
-    forward into the sequence outputs, so h_seq[-1]/c_seq[-1] ARE the
-    final states."""
+    h = o * tanh(c)), with the reference's peephole checks (hl_lstm_ops:
+    i/f see c_{t-1}, o sees c_t) when ``w_peep`` [3, H] is given — pass
+    None (or zeros) for a plain LSTM; the zero rows reproduce it exactly.
+    gates_tm [T, B, 4H] already holds W_in·x + b. Returns
+    (h_seq_tm [T, B, H], h_f, c_f). Masked steps copy state forward into
+    the sequence outputs, so h_seq[-1]/c_seq[-1] ARE the final states."""
     t, b, g4 = gates_tm.shape
     mode = lstm_mode(b, g4 // 4, gates_tm.dtype) or "resident"
-    h_seq, c_seq = _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0, mode)
+    peep = _peep_or_zeros(w_peep, g4 // 4)
+    h_seq, c_seq = _lstm_fwd(gates_tm, mask_tm, w_rec, peep, h0, c0, mode)
     return h_seq, h_seq[-1], c_seq[-1]
 
 
-def _vjp_fwd(gates_tm, mask_tm, w_rec, h0, c0):
+def _peep_or_zeros(w_peep, hidden):
+    if w_peep is None:
+        return jnp.zeros((3, hidden), jnp.float32)
+    return _f32(w_peep.reshape(3, hidden))
+
+
+def _vjp_fwd(gates_tm, mask_tm, w_rec, h0, c0, w_peep=None):
     t, b, g4 = gates_tm.shape
     mode = lstm_mode(b, g4 // 4, gates_tm.dtype) or "resident"
-    h_seq, c_seq = _lstm_fwd(gates_tm, mask_tm, w_rec, h0, c0, mode)
+    peep = _peep_or_zeros(w_peep, g4 // 4)
+    h_seq, c_seq = _lstm_fwd(gates_tm, mask_tm, w_rec, peep, h0, c0, mode)
     return ((h_seq, h_seq[-1], c_seq[-1]),
-            (gates_tm, mask_tm, w_rec, h0, c0, h_seq, c_seq))
+            (gates_tm, mask_tm, w_rec, h0, c0, w_peep, h_seq, c_seq))
 
 
 def _vjp_bwd(res, cotangents):
-    gates_tm, mask_tm, w_rec, h0, c0, h_seq, c_seq = res
+    gates_tm, mask_tm, w_rec, h0, c0, w_peep, h_seq, c_seq = res
     t, b, g4 = gates_tm.shape
-    mode = lstm_mode(b, g4 // 4, gates_tm.dtype) or "resident"
+    hidden = g4 // 4
+    mode = lstm_mode(b, hidden, gates_tm.dtype) or "resident"
+    peep = _peep_or_zeros(w_peep, hidden)
     dh_seq, dh_f, dc_f = cotangents
     hprev_tm = jnp.concatenate([h0[None], h_seq[:-1]], axis=0)
     cprev_tm = jnp.concatenate([c0[None], c_seq[:-1]], axis=0)
     bwd = _lstm_bwd_tiled if mode == "tiled" else _lstm_bwd_resident
-    dgates, dh0, dc0 = bwd(gates_tm, mask_tm, w_rec, hprev_tm, cprev_tm,
-                           c_seq, dh_seq, dh_f, dc_f)
+    dgates, dh0, dc0, dpeep = bwd(gates_tm, mask_tm, w_rec, peep, hprev_tm,
+                                  cprev_tm, c_seq, dh_seq, dh_f, dc_f)
     # weight grad as one big MXU GEMM outside the kernel (fp32 accumulation)
     dw = jnp.einsum("tbh,tbg->hg", hprev_tm, dgates,
                     preferred_element_type=jnp.float32,
                     precision=_dot_precision(hprev_tm.dtype)).astype(w_rec.dtype)
-    return dgates, None, dw, dh0, dc0
+    dw_peep = (None if w_peep is None
+               else dpeep.reshape(w_peep.shape).astype(w_peep.dtype))
+    return dgates, None, dw, dh0, dc0, dw_peep
 
 
 lstm_fused.defvjp(_vjp_fwd, _vjp_bwd)

@@ -9,8 +9,10 @@ small recurrent h/c state with the [H, 4H] recurrent matmul per step; masking
 freezes state past each sequence's end — exactly the effect the reference got
 from sorting sequences by length and shrinking the active batch.
 
-Gate layout follows the reference checkpoint convention (hl_lstm weights):
-[input, forget, cell(candidate), output] concatenated on the last axis.
+Gate layout here is [input, forget, cell(candidate), output] on the last
+axis. (The reference's native buffer order is [candidate, input, forget,
+output] — hl_cpu_lstm.cuh:42-45; importing a reference-trained checkpoint
+byte-for-byte would need a column remap, which nothing here does yet.)
 """
 
 from functools import partial
@@ -135,11 +137,12 @@ def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
     # hl_cuda_lstm.cu handles all sizes). Only the real TPU backend (or the
     # tests' explicit interpret flag) takes this path — other backends
     # where pallas merely imports would fail at lowering.
-    if (pk.enabled() and standard_acts and not use_peephole
+    if (pk.enabled() and standard_acts
             and gates_tm.dtype in (jnp.float32, jnp.bfloat16)
             and pk.lstm_mode(b_, hidden, gates_tm.dtype) is not None):
         h_seq_tm, h_f, c_f = pk.lstm_fused(
-            gates_tm, mask_tm.astype(jnp.float32), w_rec, h0, c0)
+            gates_tm, mask_tm.astype(jnp.float32), w_rec, h0, c0,
+            w_peep if use_peephole else None)
         ys = h_seq_tm
     else:
         step = partial(lstm_step, w_rec=w_rec, gate_act=gate_act,

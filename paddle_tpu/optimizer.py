@@ -64,12 +64,22 @@ class Optimizer:
     def __init__(self, learning_rate=1e-3, regularization=None,
                  gradient_clipping_threshold=None, model_average=None,
                  learning_rate_decay_a=0.0, learning_rate_decay_b=0.0,
-                 learning_rate_schedule="constant", sparse=False):
+                 learning_rate_schedule="constant", sparse=False,
+                 slot_dtype=None):
         self.lr_fn = make_lr_schedule(
             learning_rate, learning_rate_decay_a, learning_rate_decay_b,
             learning_rate_schedule)
         self.regularization = regularization
         self.clip = gradient_clipping_threshold
+        # Optional reduced-precision optimizer slots (momentum velocity,
+        # Adam moments): the big-CNN update is pure HBM bandwidth on the
+        # f32 master params (AlexNet: ~2.2ms/step on 61M params, RESULTS
+        # "known ceilings"); bf16 slots halve the slot traffic. Update
+        # ARITHMETIC always runs f32 (slots are upcast on read, rounded on
+        # store); params themselves stay full precision. Guarded by the
+        # lockstep-vs-f32 tolerance test (test_optimizers.py). Reference
+        # capability bar: the fused TrainingAlgorithmOp.cu updates.
+        self.slot_dtype = jnp.dtype(slot_dtype) if slot_dtype else None
         if model_average is not None and not isinstance(model_average, float):
             model_average = model_average.decay
         self.model_average = model_average
@@ -86,6 +96,19 @@ class Optimizer:
     def init_slot(self, param):
         """Per-parameter optimizer slots (a pytree of arrays)."""
         return ()
+
+    def _slot_zeros(self, param):
+        """Moment-slot storage: param-shaped zeros in slot_dtype (or the
+        param's own dtype)."""
+        return jnp.zeros(param.shape, self.slot_dtype or param.dtype)
+
+    @staticmethod
+    def _acc(slot_arr, like):
+        """Upcast a stored slot to the update-arithmetic dtype (f32)."""
+        return slot_arr.astype(jnp.promote_types(like.dtype, jnp.float32))
+
+    def _store(self, acc_arr):
+        return acc_arr.astype(self.slot_dtype) if self.slot_dtype else acc_arr
 
     def apply_update(self, grad, slot, param, lr):
         """Pure per-parameter update; returns (delta, new_slot) where
@@ -218,18 +241,18 @@ class Momentum(Optimizer):
     def init_slot(self, param):
         if self.mu == 0.0:
             return ()
-        return (jnp.zeros_like(param),)
+        return (self._slot_zeros(param),)
 
     def apply_update(self, grad, slot, param, lr):
         if self.mu == 0.0:
             return -lr * grad, ()
         (vel,) = slot
-        new_vel = self.mu * vel - lr * grad
+        new_vel = self.mu * self._acc(vel, grad) - lr * grad
         if self.nesterov:
             delta = self.mu * new_vel - lr * grad
         else:
             delta = new_vel
-        return delta, (new_vel,)
+        return delta, (self._store(new_vel),)
 
 
 SGD = Momentum
@@ -244,19 +267,19 @@ class Adam(Optimizer):
         self.b1, self.b2, self.eps = beta1, beta2, epsilon
 
     def init_slot(self, param):
-        return (jnp.zeros_like(param), jnp.zeros_like(param),
+        return (self._slot_zeros(param), self._slot_zeros(param),
                 jnp.zeros((), jnp.int32))
 
     def apply_update(self, grad, slot, param, lr):
         m, v, t = slot
         t = t + 1
-        m = self.b1 * m + (1.0 - self.b1) * grad
-        v = self.b2 * v + (1.0 - self.b2) * grad * grad
+        m = self.b1 * self._acc(m, grad) + (1.0 - self.b1) * grad
+        v = self.b2 * self._acc(v, grad) + (1.0 - self.b2) * grad * grad
         tf = t.astype(grad.dtype)
         m_hat = m / (1.0 - jnp.power(self.b1, tf))
         v_hat = v / (1.0 - jnp.power(self.b2, tf))
         delta = -lr * m_hat / (jnp.sqrt(v_hat) + self.eps)
-        return delta, (m, v, t)
+        return delta, (self._store(m), self._store(v), t)
 
 
 class Adamax(Optimizer):
@@ -268,21 +291,27 @@ class Adamax(Optimizer):
         self.b1, self.b2 = beta1, beta2
 
     def init_slot(self, param):
-        return (jnp.zeros_like(param), jnp.zeros_like(param),
+        return (self._slot_zeros(param), self._slot_zeros(param),
                 jnp.zeros((), jnp.int32))
 
     def apply_update(self, grad, slot, param, lr):
         m, u, t = slot
         t = t + 1
-        m = self.b1 * m + (1.0 - self.b1) * grad
-        u = jnp.maximum(self.b2 * u, jnp.abs(grad))
+        m = self.b1 * self._acc(m, grad) + (1.0 - self.b1) * grad
+        u = jnp.maximum(self.b2 * self._acc(u, grad), jnp.abs(grad))
         tf = t.astype(grad.dtype)
         delta = -lr / (1.0 - jnp.power(self.b1, tf)) * m / (u + 1e-12)
-        return delta, (m, u, t)
+        return delta, (self._store(m), self._store(u), t)
 
 
 class AdaGrad(Optimizer):
-    """reference: AdagradParameterOptimizer (FirstOrderOptimizer.h:near 80)."""
+    """reference: AdagradParameterOptimizer (FirstOrderOptimizer.h:near 80).
+
+    ``slot_dtype`` is deliberately NOT applied here: AdaGrad's accumulator
+    grows without bound, and once it is ~2^8 larger than a grad^2 step a
+    bfloat16 store stops absorbing increments entirely (8-bit mantissa) —
+    the lr decay would freeze. The EMA-decayed accumulators (RMSProp,
+    AdaDelta, DecayedAdaGrad) are bounded and keep the option."""
 
     def __init__(self, epsilon=1e-6, **kw):
         kw.setdefault("learning_rate", 1e-2)
@@ -290,7 +319,7 @@ class AdaGrad(Optimizer):
         self.eps = epsilon
 
     def init_slot(self, param):
-        return (jnp.zeros_like(param),)
+        return (jnp.zeros_like(param),)  # always f32: unbounded sum
 
     def apply_update(self, grad, slot, param, lr):
         (accum,) = slot
@@ -308,13 +337,13 @@ class DecayedAdaGrad(Optimizer):
         self.rho, self.eps = rho, epsilon
 
     def init_slot(self, param):
-        return (jnp.zeros_like(param),)
+        return (self._slot_zeros(param),)
 
     def apply_update(self, grad, slot, param, lr):
         (accum,) = slot
-        accum = self.rho * accum + (1.0 - self.rho) * grad * grad
+        accum = self.rho * self._acc(accum, grad) + (1.0 - self.rho) * grad * grad
         delta = -lr * grad / (jnp.sqrt(accum) + self.eps)
-        return delta, (accum,)
+        return delta, (self._store(accum),)
 
 
 class AdaDelta(Optimizer):
@@ -326,15 +355,17 @@ class AdaDelta(Optimizer):
         self.rho, self.eps = rho, epsilon
 
     def init_slot(self, param):
-        return (jnp.zeros_like(param), jnp.zeros_like(param))
+        return (self._slot_zeros(param), self._slot_zeros(param))
 
     def apply_update(self, grad, slot, param, lr):
         accum_g, accum_x = slot
-        accum_g = self.rho * accum_g + (1.0 - self.rho) * grad * grad
-        update = -(jnp.sqrt(accum_x + self.eps) /
+        accum_g = self.rho * self._acc(accum_g, grad) \
+            + (1.0 - self.rho) * grad * grad
+        update = -(jnp.sqrt(self._acc(accum_x, grad) + self.eps) /
                    jnp.sqrt(accum_g + self.eps)) * grad
-        accum_x = self.rho * accum_x + (1.0 - self.rho) * update * update
-        return lr * update, (accum_g, accum_x)
+        accum_x = self.rho * self._acc(accum_x, grad) \
+            + (1.0 - self.rho) * update * update
+        return lr * update, (self._store(accum_g), self._store(accum_x))
 
 
 class RMSProp(Optimizer):
@@ -346,14 +377,15 @@ class RMSProp(Optimizer):
         self.rho, self.eps = rho, epsilon
 
     def init_slot(self, param):
-        return (jnp.zeros_like(param), jnp.zeros_like(param))
+        return (self._slot_zeros(param), self._slot_zeros(param))
 
     def apply_update(self, grad, slot, param, lr):
         accum, mean = slot
-        accum = self.rho * accum + (1.0 - self.rho) * grad * grad
-        mean = self.rho * mean + (1.0 - self.rho) * grad
+        accum = self.rho * self._acc(accum, grad) \
+            + (1.0 - self.rho) * grad * grad
+        mean = self.rho * self._acc(mean, grad) + (1.0 - self.rho) * grad
         delta = -lr * grad / jnp.sqrt(accum - mean * mean + self.eps)
-        return delta, (accum, mean)
+        return delta, (self._store(accum), self._store(mean))
 
 
 class L2Regularization:

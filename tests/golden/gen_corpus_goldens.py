@@ -1,0 +1,70 @@
+"""Regenerate the checked-in corpus goldens (tests/golden/corpus/).
+
+Run ONLY after verifying a structural change is intentional:
+
+    python tests/golden/gen_corpus_goldens.py          # diff-style report
+    python tests/golden/gen_corpus_goldens.py --update # rewrite goldens
+
+The corpus list is the reference's own official file_list.sh set
+(tests/test_config_corpus.py OFFICIAL).
+"""
+
+import argparse
+import difflib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import corpus_util
+from test_config_corpus import OFFICIAL
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true")
+    args = ap.parse_args()
+
+    os.makedirs(corpus_util.GOLDEN_DIR, exist_ok=True)
+    changed = 0
+    refmatch = {}
+    for name in OFFICIAL:
+        topo, _ = corpus_util.build_config(name)
+        dump = corpus_util.canonical_dump(topo)
+        cc = corpus_util.ref_crosscheck(name, topo)
+        if cc is not None:
+            refmatch[name] = {"layers_matched": cc["layers_matched"],
+                              "layers_total": cc["layers_total"],
+                              "params_matched": cc["params_matched"],
+                              "params_total": cc["params_total"]}
+        path = corpus_util.golden_path(name)
+        old = open(path).read() if os.path.exists(path) else ""
+        if dump == old:
+            continue
+        changed += 1
+        if args.update:
+            with open(path, "w") as fh:
+                fh.write(dump)
+            print("updated %s" % path)
+        else:
+            sys.stdout.writelines(difflib.unified_diff(
+                old.splitlines(True), dump.splitlines(True),
+                "golden/%s" % name, "current/%s" % name))
+    if args.update:
+        # pin the ref-protostr match floor (test_config_corpus
+        # test_ref_protostr_crosscheck: counts may grow, never shrink)
+        with open(os.path.join(corpus_util.GOLDEN_DIR,
+                               "refmatch.json"), "w") as fh:
+            json.dump(refmatch, fh, indent=1, sort_keys=True)
+    print("%d config(s) %s" % (changed,
+                               "updated" if args.update else "differ"))
+    return 1 if (changed and not args.update) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Minimal text-format protobuf reader for the reference's checked-in
+config goldens (python/paddle/trainer_config_helpers/tests/configs/
+protostr/*.protostr) — enough structure to cross-check layer sizes and
+parameter shapes without compiling the reference's proto schema.
+
+Returns plain dicts: repeated message fields become lists of dicts,
+repeated scalars become lists, scalars parse to int/float/bool/str.
+"""
+
+import re
+
+_SCALAR = re.compile(r'^([A-Za-z_][A-Za-z0-9_]*)\s*:\s*(.+)$')
+_OPEN = re.compile(r'^([A-Za-z_][A-Za-z0-9_]*)\s*\{$')
+
+
+def _coerce(text):
+    text = text.strip()
+    if text.startswith('"'):
+        return text[1:-1]
+    if text in ("true", "false"):
+        return text == "true"
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        return text
+
+
+def _add(container, key, value):
+    if key in container:
+        prev = container[key]
+        if not isinstance(prev, list):
+            container[key] = [prev]
+        container[key].append(value)
+    else:
+        container[key] = value
+
+
+def parse_protostr(text):
+    root = {}
+    stack = [root]
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith('#'):
+            continue
+        m = _OPEN.match(line)
+        if m:
+            child = {}
+            _add(stack[-1], m.group(1), child)
+            stack.append(child)
+            continue
+        if line == '}':
+            stack.pop()
+            continue
+        m = _SCALAR.match(line)
+        if m:
+            _add(stack[-1], m.group(1), _coerce(m.group(2)))
+    return root
+
+
+def as_list(value):
+    if value is None:
+        return []
+    return value if isinstance(value, list) else [value]
+
+
+def ref_layers(msg):
+    """name -> {type, size, inputs: [layer names]} from a parsed golden."""
+    out = {}
+    for lc in as_list(msg.get("layers")):
+        ins = [i.get("input_layer_name")
+               for i in as_list(lc.get("inputs"))
+               if isinstance(i, dict) and i.get("input_layer_name")]
+        out[lc["name"]] = {"type": lc.get("type"),
+                           "size": lc.get("size"),
+                           "inputs": ins}
+    return out
+
+
+def ref_parameters(msg):
+    """name -> {size, dims} from a parsed golden."""
+    out = {}
+    for pc in as_list(msg.get("parameters")):
+        out[pc["name"]] = {"size": pc.get("size"),
+                           "dims": [int(d) for d in as_list(pc.get("dims"))]}
+    return out

@@ -145,3 +145,34 @@ def test_evaluator_aliases():
     assert ev.ctc_error_evaluator is ev.ctc_error
     assert ev.detection_map_evaluator is ev.detection_map
     assert ev.pnpair_evaluator is ev.pnpair
+
+
+def test_seq_classification_error():
+    """A sequence is ONE error if any frame is wrong; denominator = number
+    of sequences (reference Evaluator.cpp:136-173)."""
+    import jax
+    from paddle_tpu import layer as L, data_type as dt
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.topology import Topology
+
+    reset_name_counters()
+    x = L.data(name="scores", type=dt.dense_vector_sequence(3))
+    y = L.data(name="lab", type=dt.integer_value_sequence(3))
+    node = ev.seq_classification_error(input=x, label=y)
+    topo = Topology(node)
+
+    # batch of 3 sequences: seq0 all right, seq1 one wrong frame,
+    # seq2 all wrong -> 2 errors / 3 sequences
+    scores = np.zeros((3, 2, 3), np.float32)
+    scores[0, 0, 1] = 1.0; scores[0, 1, 2] = 1.0      # predicts 1,2
+    scores[1, 0, 0] = 1.0; scores[1, 1, 0] = 1.0      # predicts 0,0
+    scores[2, 0, 2] = 1.0                             # predicts 2 (len 1)
+    labels = np.array([[1, 2], [0, 1], [0, 0]], np.int32)
+    feed = {"scores": SequenceBatch(scores, np.array([2, 2, 1])),
+            "lab": SequenceBatch(labels, np.array([2, 2, 1]))}
+    out, _ = topo.apply({}, feed, mode="test")
+    stats = {k: np.asarray(v) for k, v in out[node.name].items()}
+    assert stats["wrong"] == 2.0 and stats["total"] == 3.0
+    acc = node.merge(None, stats)
+    assert abs(node.result(acc) - 2.0 / 3.0) < 1e-6

@@ -417,3 +417,86 @@ def test_param_pool_trainer_checkpoint_roundtrip(tmp_path):
         np.testing.assert_allclose(np.asarray(params.get(name)),
                                    np.asarray(params2.get(name)),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_bf16_slots_track_f32_momentum():
+    """slot_dtype="bfloat16" halves optimizer HBM slot traffic (the
+    AlexNet update is pure bandwidth); the rounded velocity must stay in
+    lockstep with the f32 reference within bf16 noise over many steps."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    p0 = jnp.asarray(rng.randn(64, 32), jnp.float32)
+    ref = opt.Momentum(learning_rate=0.05, momentum=0.9)
+    low = opt.Momentum(learning_rate=0.05, momentum=0.9,
+                       slot_dtype="bfloat16")
+    pr, pl = {"w": p0}, {"w": p0}
+    sr, sl = ref.init_state(pr), low.init_state(pl)
+    assert sl["slots"]["w"][0].dtype == jnp.bfloat16
+    for i in range(60):
+        g = {"w": jnp.asarray(rng.randn(64, 32) * 0.1, jnp.float32)}
+        pr, sr = ref.step(pr, g, sr)
+        pl, sl = low.step(pl, g, sl)
+    scale = float(jnp.max(jnp.abs(pr["w"])))
+    err = float(jnp.max(jnp.abs(pr["w"] - pl["w"]))) / max(scale, 1e-6)
+    assert err < 2e-2, "bf16-slot drift vs f32 momentum: rel %.4g" % err
+
+
+def test_bf16_slots_track_f32_adam():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    p0 = jnp.asarray(rng.randn(32, 16), jnp.float32)
+    ref = opt.Adam(learning_rate=0.01)
+    low = opt.Adam(learning_rate=0.01, slot_dtype="bfloat16")
+    pr, pl = {"w": p0}, {"w": p0}
+    sr, sl = ref.init_state(pr), low.init_state(pl)
+    m, v, t = sl["slots"]["w"]
+    assert m.dtype == jnp.bfloat16 and v.dtype == jnp.bfloat16
+    assert t.dtype == jnp.int32  # the step counter must stay exact
+    for i in range(60):
+        g = {"w": jnp.asarray(rng.randn(32, 16) * 0.1, jnp.float32)}
+        pr, sr = ref.step(pr, g, sr)
+        pl, sl = low.step(pl, g, sl)
+    scale = float(jnp.max(jnp.abs(pr["w"])))
+    err = float(jnp.max(jnp.abs(pr["w"] - pl["w"]))) / max(scale, 1e-6)
+    assert err < 5e-2, "bf16-slot drift vs f32 adam: rel %.4g" % err
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (opt.Adamax, {"learning_rate": 0.01}),
+    (opt.RMSProp, {"learning_rate": 0.005}),
+    (opt.AdaDelta, {}),
+    (opt.DecayedAdaGrad, {"learning_rate": 0.01}),
+])
+def test_bf16_slots_track_f32_ema_family(cls, kw):
+    """Every EMA-decayed-slot optimizer honoring slot_dtype must stay in
+    lockstep with its f32 twin (bounded accumulators -> bf16-safe)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    p0 = jnp.asarray(rng.randn(32, 16), jnp.float32)
+    ref, low = cls(**kw), cls(slot_dtype="bfloat16", **kw)
+    pr, pl = {"w": p0}, {"w": p0}
+    sr, sl = ref.init_state(pr), low.init_state(pl)
+    assert any(getattr(a, "dtype", None) == jnp.bfloat16
+               for a in sl["slots"]["w"])
+    for i in range(60):
+        g = {"w": jnp.asarray(rng.randn(32, 16) * 0.1, jnp.float32)}
+        pr, sr = ref.step(pr, g, sr)
+        pl, sl = low.step(pl, g, sl)
+    scale = float(jnp.max(jnp.abs(pr["w"])))
+    err = float(jnp.max(jnp.abs(pr["w"] - pl["w"]))) / max(scale, 1e-6)
+    assert err < 6e-2, "%s bf16-slot drift: rel %.4g" % (cls.__name__, err)
+
+
+def test_adagrad_ignores_slot_dtype():
+    """AdaGrad's accumulator is an unbounded sum — a bf16 store would stop
+    absorbing grad^2 once large (8-bit mantissa), freezing the lr decay;
+    the option is deliberately inert there (optimizer.py docstring)."""
+    import jax.numpy as jnp
+
+    o = opt.AdaGrad(slot_dtype="bfloat16")
+    state = o.init_state({"w": jnp.ones((4, 4), jnp.float32)})
+    (accum,) = state["slots"]["w"]
+    assert accum.dtype == jnp.float32

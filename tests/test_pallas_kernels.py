@@ -114,10 +114,45 @@ def test_lstmemory_layer_uses_fused_and_matches():
     vals, _ = topo.apply(params, feed, mode="test")
     got = np.asarray(vals["m"].data)
 
-    gates = sb.data + params["m.wbias"]
+    # reference 7H bias layout (LstmLayer.cpp:32): gates then peep checks
+    assert params["m.wbias"].shape == (7 * H,)
+    gates = sb.data + params["m.wbias"][:4 * H]
     want, _ = rnn_ops.lstm_scan(gates, sb.mask(jnp.float32), None, None,
-                                params["m.w0"], standard_acts=False)
+                                params["m.w0"], standard_acts=False,
+                                use_peephole=True,
+                                w_peep=params["m.wbias"][4 * H:])
     np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("hidden", [H, 256])  # 256 -> tiled kernel
+def test_lstm_fused_peephole_matches_scan_and_grads(hidden):
+    """Nonzero peephole checks: fused kernel (resident AND tiled) vs
+    lax.scan, forward + grads for gates, w_rec AND the peephole vectors
+    (hl_lstm_ops parity)."""
+    rng = np.random.RandomState(11)
+    gates = jnp.asarray(rng.randn(B, T, 4 * hidden) * 0.5, jnp.float32)
+    lengths = np.array([6, 3, 5, 1])
+    mask = jnp.asarray((np.arange(T)[None, :] < lengths[:, None]),
+                       jnp.float32)
+    w = jnp.asarray(rng.randn(hidden, 4 * hidden) / np.sqrt(hidden),
+                    jnp.float32)
+    peep = jnp.asarray(rng.randn(3 * hidden) * 0.5, jnp.float32)
+    proj = jnp.asarray(rng.randn(B, T, hidden), jnp.float32)
+
+    def loss(standard, gates, w, peep):
+        h_seq, (h_f, c_f) = rnn_ops.lstm_scan(
+            gates, mask, None, None, w, standard_acts=standard,
+            use_peephole=True, w_peep=peep)
+        return jnp.sum(h_seq * proj) + jnp.sum(h_f) + 0.5 * jnp.sum(c_f)
+
+    ref, gref = jax.value_and_grad(
+        lambda *a: loss(False, *a), argnums=(0, 1, 2))(gates, w, peep)
+    fus, gfus = jax.value_and_grad(
+        lambda *a: loss(True, *a), argnums=(0, 1, 2))(gates, w, peep)
+    np.testing.assert_allclose(float(fus), float(ref), rtol=1e-5)
+    for got, want, nm in zip(gfus, gref, ("dgates", "dw", "dpeep")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-4, atol=3e-5, err_msg=nm)
 
 
 def test_lstm_tiled_forward_and_grads_match_scan():
