@@ -72,39 +72,44 @@ def _gate_check_lstm(hidden, dtype_name, batch=8, t=12):
     lengths[0] = t
     mask = jnp.asarray(np.arange(t)[None, :] < lengths[:, None], jnp.float32)
     w = jnp.asarray(rng.randn(hidden, 4 * hidden) / np.sqrt(hidden), dtype)
+    # nonzero peephole checks: the flagship lstmemory runs the peephole
+    # kernel (reference 7h-bias semantics), so the gate must exercise it
+    peep = jnp.asarray(rng.randn(3 * hidden) * 0.3, jnp.float32)
     sel = jnp.asarray(rng.randn(batch, t, hidden), jnp.float32)
     sf = jnp.asarray(rng.randn(batch, hidden), jnp.float32)
 
-    def loss(standard, g, w):
+    def loss(standard, g, w, p):
         h_seq, (h_f, c_f) = rnn_ops.lstm_scan(
-            g, mask, None, None, w, standard_acts=standard)
+            g, mask, None, None, w, standard_acts=standard,
+            use_peephole=True, w_peep=p)
         return (jnp.sum(h_seq.astype(jnp.float32) * sel)
                 + jnp.sum(h_f.astype(jnp.float32) * sf)
                 + 0.5 * jnp.sum(c_f.astype(jnp.float32) * sf))
 
     @jax.jit
-    def both(g, w):
-        ref, gr = jax.value_and_grad(lambda g, w: loss(False, g, w),
-                                     argnums=(0, 1))(g, w)
-        fus, gf = jax.value_and_grad(lambda g, w: loss(True, g, w),
-                                     argnums=(0, 1))(g, w)
+    def both(g, w, p):
+        ref, gr = jax.value_and_grad(lambda g, w, p: loss(False, g, w, p),
+                                     argnums=(0, 1, 2))(g, w, p)
+        fus, gf = jax.value_and_grad(lambda g, w, p: loss(True, g, w, p),
+                                     argnums=(0, 1, 2))(g, w, p)
         return ref, fus, gr, gf
 
-    ref, fus, gr, gf = jax.device_get(both(gates, w))
+    ref, fus, gr, gf = jax.device_get(both(gates, w, peep))
     tol = GATE_TOL[dtype_name]
     scale = max(1.0, abs(float(ref)))
     _gate_require(
         abs(float(fus) - float(ref)) / scale < tol,
         "lstm fwd mismatch h=%d %s: %r vs %r" % (hidden, dtype_name,
                                                  float(fus), float(ref)))
-    for got, want, nm in ((gf[0], gr[0], "dgates"), (gf[1], gr[1], "dw")):
+    for got, want, nm in ((gf[0], gr[0], "dgates"), (gf[1], gr[1], "dw"),
+                          (gf[2], gr[2], "dpeep")):
         got32 = np.asarray(got, np.float32)
         want32 = np.asarray(want, np.float32)
         denom = max(1.0, float(np.abs(want32).max()))
         err = float(np.abs(got32 - want32).max()) / denom
         _gate_require(err < tol, "lstm %s grad mismatch h=%d %s: rel %.4g"
                       % (nm, hidden, dtype_name, err))
-    return "lstm[h=%d,%s,%s]" % (hidden, dtype_name, mode)
+    return "lstm[h=%d,%s,%s,peephole]" % (hidden, dtype_name, mode)
 
 
 def _gate_check_gru(hidden, dtype_name, batch=8, t=12):

@@ -122,6 +122,13 @@ def featurewise(fn, value):
         # per-feature params all index it); the NHWC fast path is taken
         # explicitly by finalize() for provably-elementwise fns only
         return fn(value.flat())
+    from paddle_tpu.core.sparse import SparseRows
+
+    if isinstance(value, SparseRows):
+        # layers without a sparse fast path operate on the dense rows
+        # (to_dense refuses at reference scale); fc bypasses featurewise
+        # with the gather/weighted-sum matmul
+        return fn(value.to_dense())
     return fn(value)
 
 
@@ -130,6 +137,13 @@ def data_of(value):
         return value.data
     if isinstance(value, ImageValue):
         return value.flat()
+    from paddle_tpu.core.sparse import SparseRows
+
+    if isinstance(value, SparseRows):
+        # layers without a sparse fast path see the dense rows; to_dense
+        # refuses at reference scale (core/sparse.py) so a million-dim
+        # slot can't silently materialize
+        return value.to_dense()
     return value
 
 

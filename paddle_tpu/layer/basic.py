@@ -50,6 +50,13 @@ def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
     def forward(params, values, ctx):
         def matmul(value, spec):
             w = params[spec.name]
+            from paddle_tpu.core.sparse import SparseRows
+
+            if isinstance(value, SparseRows):
+                # sparse fast path: row gather + weighted K-sum — the
+                # reference's sparse FC (SparseRowMatrix mul) without
+                # densifying (K*size reads instead of dim*size)
+                return value.matmul(w)
             return featurewise(lambda d: jnp.matmul(d, w), value)
 
         out = matmul(values[0], specs[0])

@@ -17,6 +17,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.core.dtype import matmul_precision
+from paddle_tpu.utils import flags as _flags
+
+_flags.define_flag("lrn_bf16_band", False,
+                   "use bf16 operands for the LRN banded matmul (measured "
+                   "slower on v5e; trace-time flag)")
 
 
 def conv2d(x_nhwc, w_hwio, stride=(1, 1), padding="SAME", groups=1, dilation=(1, 1)):
@@ -333,11 +338,18 @@ def cross_map_norm_auto(x_nhwc, size, scale, power):
     if c > 1024:
         return cross_map_norm(x_nhwc, size, scale, power)
     alpha = scale / size
-    if x_nhwc.dtype == jnp.bfloat16:
+    from paddle_tpu.utils import flags
+
+    if x_nhwc.dtype == jnp.bfloat16 and flags.get_flag("lrn_bf16_band"):
         # keep the big [B*H*W, C] operands in bf16 (the f32 spelling made
         # the x^2 pass + band matmuls the largest backward dots in the
         # AlexNet profile — 148MB f32 intermediates at conv1); the dot
-        # still ACCUMULATES f32, and base/power run f32 per element
+        # still ACCUMULATES f32, and base/power run f32 per element.
+        # OFF by default: measured on v5e it REGRESSED the AlexNet step
+        # 10.0 -> 13.9 ms (XLA lowers the bf16 band dot + its backward
+        # with extra converts/layouts that cost more than the f32 reads
+        # saved) — kept only for future re-evaluation. Flag is read at
+        # TRACE time: flip it before the first jit of the model.
         x2 = x_nhwc * x_nhwc
         band = jnp.asarray(_lrn_band(c, size), jnp.bfloat16)
         s = lax.dot(x2.reshape(-1, c), band,

@@ -51,8 +51,10 @@ def lstm_step(carry, gates_t, w_rec, mask_t, gate_act, state_act,
     o = gate_act(zo)
     h = o * (out_act or state_act)(c)
     m = mask_t[:, None]
-    h = jnp.where(m, h, h_prev)
-    c = jnp.where(m, c, c_prev)
+    # f32 peephole checks promote the elementwise chain; the carry keeps
+    # the compute dtype (the fused kernel equally stores state in dt)
+    h = jnp.where(m, h, h_prev).astype(h_prev.dtype)
+    c = jnp.where(m, c, c_prev).astype(c_prev.dtype)
     return (h, c), h
 
 

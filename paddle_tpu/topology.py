@@ -17,7 +17,15 @@ from paddle_tpu.core import dtype as dtype_mod
 from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
 from paddle_tpu.data_type import DENSE, INDEX, SEQ_NESTED, SEQ_NONE, SEQ_SINGLE, SPARSE_BINARY, SPARSE_FLOAT
 from paddle_tpu.graph import Context, LayerNode, topo_sort
+from paddle_tpu.utils import flags
 from paddle_tpu.utils.error import enforce
+
+# sparse slots at/above this dim feed as SparseRows (padded id lists);
+# below it they densify at the boundary (cheap at quick_start scale)
+flags.define_flag("sparse_feed_threshold", 4096,
+                  "sparse_binary/float_vector slots with dim >= this use "
+                  "the gather/weighted-sum sparse path instead of dense "
+                  "[B, dim] conversion")
 
 
 def _external(value):
@@ -224,9 +232,10 @@ def convert_feed(topology, data_batch, feeding=None):
     Parity with py_paddle DataProviderConverter (reference:
     paddle/py_paddle/dataprovider_converter.py): dense slots become [B, dim]
     arrays, index slots int32 [B], sequence slots SequenceBatch, nested
-    slots NestedSequenceBatch, sparse slots are densified (TPU path keeps
-    embeddings dense-gathered; true sparse storage lives in the sparse
-    embedding subsystem).
+    slots NestedSequenceBatch. Sparse slots densify below
+    ``sparse_feed_threshold`` dims and feed as :class:`SparseRows` (padded
+    id lists; fc consumes them via gather/weighted-sum) at or above it —
+    the reference's million-dim sparse FC capability.
     """
     names = [name for name, _ in topology.data_types()]
     if feeding is None:
@@ -251,6 +260,15 @@ def convert_column(col, itype):
         if itype.value_type == INDEX:
             return jnp.asarray(np.asarray(col, dtype=np.int32))
         if itype.value_type in (SPARSE_BINARY, SPARSE_FLOAT):
+            if itype.dim >= flags.get_flag("sparse_feed_threshold"):
+                # true sparse path: padded id lists + gather/weighted-sum
+                # matmul instead of [B, dim] densification — the reference's
+                # million-dim sparse FC capability (SparseRowMatrix.h:29)
+                from paddle_tpu.core.sparse import SparseRows
+
+                return SparseRows.from_rows(
+                    col, itype.dim,
+                    with_values=itype.value_type == SPARSE_FLOAT)
             return jnp.asarray(_densify(col, itype))
     elif itype.seq_type == SEQ_SINGLE:
         if itype.value_type == DENSE:
