@@ -1,10 +1,15 @@
-"""GAN demo (reference: v1_api_demo/gan gan_conf.py + gan_trainer.py).
+"""GAN demo on the MultiNetwork trainer (reference: v1_api_demo/gan
+gan_conf.py + gan_trainer.py).
 
-Trains a generator/discriminator pair with alternating updates. The
-reference used three GradientMachines over shared parameter names; here
-both subnetworks live in one parameter dict and each optimizer step
-filters gradients by name prefix — the whole D-step and G-step are each
-one jitted XLA program.
+The reference ran THREE GradientMachines over shared parameter names
+(generator trainer, discriminator trainer, generator forward machine) and
+copied parameters between them each phase. Here the same recipe is two
+named sub-networks of one :class:`paddle_tpu.multi_network.MultiNetwork`
+under a :class:`MultiNetworkTrainer`: one shared device-resident parameter
+store, one jitted step per phase, each phase updating only its own side
+(gan_conf.py's ``is_static`` freezing), and the generator's forward pass
+for fake-sample synthesis is the gen phase's extra output — no host
+copies between phases.
 
 ``--data uniform`` reproduces gan_conf.py (2-D uniform toy data, fc nets);
 ``--data mnist`` reproduces gan_conf_image.py's MNIST image GAN at mlp scale.
@@ -17,38 +22,53 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import numpy as np
-import jax
-import jax.numpy as jnp
 
 from paddle_tpu import activation as A
 from paddle_tpu import data_type as dt
 from paddle_tpu import layer as L
 from paddle_tpu import optimizer as opt
 from paddle_tpu.dataset import mnist
-from paddle_tpu.topology import Topology
+from paddle_tpu.graph import reset_name_counters
+from paddle_tpu.multi_network import MultiNetwork, MultiNetworkTrainer
 
-_EPS = 1e-8
+
+def discriminator(x, hidden):
+    """x -> p(real); parameters shared BY NAME across both sub-networks
+    (the gan_conf.py convention)."""
+    from paddle_tpu.attr import ParamAttr
+
+    h1 = L.fc(input=x, size=hidden, act=A.Relu(), name="dis_h1_%s" % x.name,
+              param_attr=ParamAttr(name="dis_h1.w"),
+              bias_attr=ParamAttr(name="dis_h1.b"))
+    h2 = L.fc(input=h1, size=hidden, act=A.Relu(),
+              name="dis_h2_%s" % x.name,
+              param_attr=ParamAttr(name="dis_h2.w"),
+              bias_attr=ParamAttr(name="dis_h2.b"))
+    return L.fc(input=h2, size=1, act=A.Sigmoid(),
+                name="dis_out_%s" % x.name,
+                param_attr=ParamAttr(name="dis_out.w"),
+                bias_attr=ParamAttr(name="dis_out.b"))
 
 
 def build(noise_dim, data_dim, hidden):
-    """Generator z->x and discriminator x->p(real), with name prefixes
-    "gen_"/"dis_" (same convention as gan_conf.py's import_prefix)."""
+    reset_name_counters()
+    # --- gen phase sub-network: noise -> G -> D -> CE(., 1) --------------
     z = L.data(name="noise", type=dt.dense_vector(noise_dim))
     g_h1 = L.fc(input=z, size=hidden, act=A.Relu(), name="gen_h1")
     g_h2 = L.fc(input=g_h1, size=hidden, act=A.Relu(), name="gen_h2")
     fake = L.fc(input=g_h2, size=data_dim, act=None, name="gen_out")
+    g_prob = discriminator(fake, hidden)
+    g_label = L.data(name="g_label", type=dt.dense_vector(1))
+    g_cost = L.multi_binary_label_cross_entropy(input=g_prob, label=g_label,
+                                                name="gen_cost")
 
+    # --- dis phase sub-network: sample -> D -> CE(., label) -------------
     x = L.data(name="sample", type=dt.dense_vector(data_dim))
-    d_h1 = L.fc(input=x, size=hidden, act=A.Relu(), name="dis_h1")
-    d_h2 = L.fc(input=d_h1, size=hidden, act=A.Relu(), name="dis_h2")
-    prob = L.fc(input=d_h2, size=1, act=A.Sigmoid(), name="dis_out")
-    return Topology(fake), Topology(prob), fake.name, prob.name
-
-
-def split(params):
-    gen = {k: v for k, v in params.items() if k.startswith("gen_")}
-    dis = {k: v for k, v in params.items() if k.startswith("dis_")}
-    return gen, dis
+    d_prob = discriminator(x, hidden)
+    d_label = L.data(name="d_label", type=dt.dense_vector(1))
+    d_cost = L.multi_binary_label_cross_entropy(input=d_prob, label=d_label,
+                                                name="dis_cost")
+    return MultiNetwork({"gen": g_cost, "dis": d_cost}), fake
 
 
 def main(argv=None):
@@ -76,69 +96,44 @@ def main(argv=None):
         def real_batch(rng, n):
             return images[rng.randint(0, len(images), size=n)]
 
-    gen_topo, dis_topo, fake_name, prob_name = build(noise_dim, data_dim,
-                                                     hidden)
-    key = jax.random.PRNGKey(0)
-    params = dict(gen_topo.init_params(key))
-    params.update(dis_topo.init_params(jax.random.fold_in(key, 1)))
-
-    g_opt = opt.Adam(learning_rate=2e-4, beta1=0.5)
-    d_opt = opt.Adam(learning_rate=2e-4, beta1=0.5)
-    gen0, dis0 = split(params)
-    g_state, d_state = g_opt.init_state(gen0), d_opt.init_state(dis0)
-
-    def generate(params, noise):
-        values, _ = gen_topo.apply(params, {"noise": noise}, mode="test")
-        return values[fake_name]
-
-    def discriminate(params, x):
-        values, _ = dis_topo.apply(params, {"sample": x}, mode="test")
-        return values[prob_name].reshape(-1)
-
-    @jax.jit
-    def d_step(params, d_state, real, noise):
-        gen_p, _ = split(params)
-
-        def loss_fn(dis_p):
-            p = {**gen_p, **dis_p}
-            fake = generate(p, noise)
-            p_real = discriminate(p, real)
-            p_fake = discriminate(p, fake)
-            return -jnp.mean(jnp.log(p_real + _EPS)
-                             + jnp.log(1.0 - p_fake + _EPS))
-
-        _, dis_p = split(params)
-        loss, grads = jax.value_and_grad(loss_fn)(dis_p)
-        new_dis, new_state = d_opt.step(dis_p, grads, d_state)
-        return {**gen_p, **new_dis}, new_state, loss
-
-    @jax.jit
-    def g_step(params, g_state, noise):
-        _, dis_p = split(params)
-
-        def loss_fn(gen_p):
-            p = {**gen_p, **dis_p}
-            return -jnp.mean(jnp.log(
-                discriminate(p, generate(p, noise)) + _EPS))
-
-        gen_p, _ = split(params)
-        loss, grads = jax.value_and_grad(loss_fn)(gen_p)
-        new_gen, new_state = g_opt.step(gen_p, grads, g_state)
-        return {**new_gen, **dis_p}, new_state, loss
+    mn, fake = build(noise_dim, data_dim, hidden)
+    trainer = MultiNetworkTrainer(
+        mn,
+        update_equations=lambda: opt.Adam(learning_rate=2e-4, beta1=0.5),
+        phase_trainable={
+            "gen": lambda p: p.startswith("gen_"),   # D frozen in gen phase
+            "dis": lambda p: p.startswith("dis_"),
+        },
+        extra_outputs={"gen": [fake]},
+    )
 
     rng = np.random.RandomState(0)
+    ones = lambda n: np.ones((n, 1), np.float32)   # noqa: E731
+    zeros = lambda n: np.zeros((n, 1), np.float32)  # noqa: E731
+    d_loss = g_loss = float("nan")
     for it in range(args.num_iters):
-        real = real_batch(rng, args.batch_size)
-        noise = rng.randn(args.batch_size, noise_dim).astype(np.float32)
-        params, d_state, d_loss = d_step(params, d_state, real, noise)
-        noise = rng.randn(args.batch_size, noise_dim).astype(np.float32)
-        params, g_state, g_loss = g_step(params, g_state, noise)
+        n = args.batch_size
+        # D phase: real (label 1) + generator fakes (label 0), fakes from
+        # the gen sub-network's forward (reference gan_trainer.py
+        # get_fake_samples)
+        noise = rng.randn(n, noise_dim).astype(np.float32)
+        fakes = trainer.infer("gen", [(z, [1.0]) for z in noise])[fake.name]
+        real = real_batch(rng, n)
+        d_batch = [(s, l) for s, l in zip(real, ones(n))] \
+            + [(s, l) for s, l in zip(fakes, zeros(n))]
+        d_loss = trainer.train_batch("dis", d_batch,
+                                     feeding={"sample": 0, "d_label": 1})
+        # G phase: fool the (frozen) discriminator
+        noise = rng.randn(n, noise_dim).astype(np.float32)
+        g_batch = [(z, l) for z, l in zip(noise, ones(n))]
+        g_loss = trainer.train_batch("gen", g_batch,
+                                     feeding={"noise": 0, "g_label": 1})
         if it % 50 == 0 or it == args.num_iters - 1:
-            print("iter %d d_loss %.4f g_loss %.4f"
-                  % (it, float(d_loss), float(g_loss)))
+            print("iter %d d_loss %.4f g_loss %.4f" % (it, d_loss, g_loss))
 
-    samples = np.asarray(generate(
-        params, jnp.asarray(rng.randn(8, noise_dim), jnp.float32)))
+    samples = trainer.infer(
+        "gen", [(z, [1.0]) for z in
+                rng.randn(8, noise_dim).astype(np.float32)])[fake.name]
     if args.data == "uniform":
         print("generated samples:\n", np.round(samples, 3))
     else:

@@ -1,0 +1,210 @@
+"""MultiNetwork: N named sub-networks under one trainer.
+
+Reference parity: the ``multi_nn`` gradient machine
+(gserver/gradientmachines/MultiNetwork.h; factory at
+GradientMachine.cpp:29) composed several NeuralNetworks in one model —
+forward/backward ran each sub-network on its slice of the input
+(Argument::splitByDataId), parameters were shared by name, and a skipped
+data id left a sub-network out of the batch.
+
+TPU-native design: sub-networks are plain cost DAGs over one shared
+parameter namespace (name-sharing already merges ParamSpecs), so
+
+* **joint training** is one fused XLA program: ``trainer.SGD(cost=
+  MultiNetwork(...))`` minimizes ``sum_i w_i * mean(cost_i)`` — the
+  multi-task use of multi_nn;
+* **alternating training** (the reference GAN recipe: one GradientMachine
+  per mode with ``is_static`` freezing, v1_api_demo/gan/gan_trainer.py) is
+  :class:`MultiNetworkTrainer`: ONE device-resident parameter store, one
+  jitted step per phase, each phase differentiating only its own trainable
+  subset — phase switches touch no host memory, unlike the reference's
+  copy-between-machines loop.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph import LayerNode
+from paddle_tpu.topology import Topology, convert_feed
+from paddle_tpu.utils.error import enforce
+
+
+class MultiNetwork:
+    """Named sub-networks: ``{name: cost}`` / ``[(name, cost, weight)]``.
+
+    Pass directly as ``trainer.SGD(cost=MultiNetwork(...))`` for joint
+    training, or to :class:`MultiNetworkTrainer` for per-phase updates.
+    """
+
+    def __init__(self, subs, weights=None):
+        if isinstance(subs, dict):
+            items = [(n, c, 1.0) for n, c in subs.items()]
+        else:
+            items = [(s[0], s[1], float(s[2]) if len(s) > 2 else 1.0)
+                     for s in subs]
+        enforce(len(items) >= 1, "MultiNetwork needs at least one "
+                "sub-network (the reference checks sub_models_size > 1 "
+                "counting its root)")
+        for n, c, _ in items:
+            enforce(isinstance(c, LayerNode),
+                    "sub-network %r cost must be a LayerNode", n)
+        self.names = [n for n, _, _ in items]
+        enforce(len(set(self.names)) == len(self.names),
+                "duplicate sub-network names")
+        self.costs = [c for _, c, _ in items]
+        self.weights = [w for _, _, w in items]
+
+    def sub(self, name):
+        return self.costs[self.names.index(name)]
+
+
+class MultiNetworkTrainer:
+    """Alternating-phase trainer over one shared parameter store.
+
+    ``update_equations``: one Optimizer per phase ({name: opt}) or a
+    factory ``lambda: opt`` applied per phase (separate slot state per
+    phase, like the reference's per-machine updaters).
+    ``phase_trainable``: {phase: predicate or name collection} restricting
+    which parameters that phase updates (``is_static`` parity — the
+    reference GAN froze the other side's params per mode); default is
+    every trainable parameter reachable from the phase's cost.
+    """
+
+    def __init__(self, multi, update_equations, phase_trainable=None,
+                 extra_outputs=None, seed=0):
+        from paddle_tpu.optimizer import Optimizer
+
+        enforce(isinstance(multi, MultiNetwork),
+                "multi must be a MultiNetwork")
+        self.multi = multi
+        phase_trainable = phase_trainable or {}
+        extra_outputs = extra_outputs or {}
+
+        if isinstance(update_equations, Optimizer):
+            enforce(len(multi.names) == 1,
+                    "one Optimizer instance cannot hold slot state for "
+                    "several phases — pass {phase: Optimizer} or a factory")
+            update_equations = {multi.names[0]: update_equations}
+        elif callable(update_equations) and \
+                not isinstance(update_equations, dict):
+            update_equations = {n: update_equations() for n in multi.names}
+        enforce(set(update_equations) == set(multi.names),
+                "update_equations must cover exactly the phases %r",
+                multi.names)
+
+        # one topology per phase + the union parameter namespace
+        self._topos = {n: Topology(c)
+                       for n, c in zip(multi.names, multi.costs)}
+        self._cost_names = {n: c.name
+                            for n, c in zip(multi.names, multi.costs)}
+        all_specs = {}
+        for topo in self._topos.values():
+            for pname, spec in topo.param_specs().items():
+                prev = all_specs.get(pname)
+                enforce(prev is None or tuple(prev.shape) == tuple(spec.shape),
+                        "shared parameter %r shape mismatch across "
+                        "sub-networks: %r vs %r (the single-topology joint "
+                        "path enforces the same)", pname,
+                        prev and tuple(prev.shape), tuple(spec.shape))
+                all_specs[pname] = spec
+        key = jax.random.PRNGKey(seed)
+        self._params = {}
+        for i, (n, topo) in enumerate(sorted(self._topos.items())):
+            init = topo.init_params(jax.random.fold_in(key, i))
+            for pname, v in init.items():
+                self._params.setdefault(pname, v)
+
+        self._state_names = {p for p, s in all_specs.items()
+                             if getattr(s, "is_state", False)}
+        self._phases = {}
+        self._rng = jax.random.PRNGKey(seed + 1)
+        for phase in multi.names:
+            topo = self._topos[phase]
+            specs = topo.param_specs()
+            reachable = [p for p in specs
+                         if p not in self._state_names
+                         and not getattr(specs[p].attr, "is_static", False)]
+            sel = phase_trainable.get(phase)
+            if sel is None:
+                train_names = reachable
+            elif callable(sel):
+                train_names = [p for p in reachable if sel(p)]
+            else:
+                train_names = [p for p in reachable if p in set(sel)]
+            enforce(train_names, "phase %r has no trainable parameters",
+                    phase)
+            optimizer = update_equations[phase]
+            meta = {p: specs[p].attr for p in train_names}
+            opt_state = optimizer.init_state(
+                {p: self._params[p] for p in train_names}, meta)
+            outs = [o.name for o in extra_outputs.get(phase, [])]
+            self._phases[phase] = {
+                "topo": topo,
+                "cost": self._cost_names[phase],
+                "train_names": train_names,
+                "train_set": set(train_names),
+                "needed": set(specs),
+                "optimizer": optimizer,
+                "meta": meta,
+                "opt_state": opt_state,
+                "outputs": outs,
+                "step": self._build_step(topo, self._cost_names[phase],
+                                         train_names, optimizer, meta),
+                "infer": self._build_infer(topo, outs
+                                           or [self._cost_names[phase]]),
+            }
+
+    def _build_step(self, topo, cost_name, train_names, optimizer, meta):
+        def step(train_p, frozen_p, opt_state, feed, rng):
+            def loss_fn(tp):
+                values, updates = topo.apply({**frozen_p, **tp}, feed,
+                                             mode="train", rng=rng)
+                return jnp.mean(values[cost_name]), updates
+
+            (loss, updates), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(train_p)
+            new_p, new_opt = optimizer.step(train_p, grads, opt_state, meta)
+            return loss, new_p, updates, new_opt
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def _build_infer(self, topo, outputs):
+        def infer(params, feed):
+            values, _ = topo.apply(params, feed, mode="test",
+                                   outputs=outputs)
+            return {o: values[o] for o in outputs}
+
+        return jax.jit(infer)
+
+    # -- API ---------------------------------------------------------------
+    def train_batch(self, phase, batch, feeding=None):
+        """One optimizer step of ``phase`` on a host minibatch (list of
+        sample tuples, v2 reader convention). Returns the phase loss."""
+        ph = self._phases[phase]
+        feed = convert_feed(ph["topo"], batch, feeding)
+        train_p = {p: self._params[p] for p in ph["train_names"]}
+        frozen_p = {p: v for p, v in self._params.items()
+                    if p in ph["needed"] and p not in ph["train_set"]}
+        self._rng, sub = jax.random.split(self._rng)
+        loss, new_p, updates, new_opt = ph["step"](
+            train_p, frozen_p, ph["opt_state"], feed, sub)
+        self._params.update(new_p)
+        self._params.update(updates)
+        ph["opt_state"] = new_opt
+        return float(loss)
+
+    def infer(self, phase, batch, feeding=None):
+        """Forward ``phase``'s sub-network (test mode) on a minibatch,
+        returning its declared extra outputs (or the cost)."""
+        ph = self._phases[phase]
+        feed = convert_feed(ph["topo"], batch, feeding)
+        params = {p: v for p, v in self._params.items()
+                  if p in ph["needed"]}
+        out = ph["infer"](params, feed)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def get_params(self):
+        """Host copies of the shared parameter store."""
+        return {p: np.asarray(v) for p, v in self._params.items()}

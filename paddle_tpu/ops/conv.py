@@ -22,6 +22,11 @@ from paddle_tpu.utils import flags as _flags
 _flags.define_flag("lrn_bf16_band", False,
                    "use bf16 operands for the LRN banded matmul (measured "
                    "slower on v5e; trace-time flag)")
+_flags.define_flag("pool_grad_mode", "",
+                   "max-pool backward: '' = XLA select_and_scatter (best "
+                   "measured), 'equality' = compare-VJP everywhere, "
+                   "'hybrid' = compare-VJP for stride-1 pools only (both "
+                   "measured SLOWER on v5e; trace-time flag)")
 
 
 def conv2d(x_nhwc, w_hwio, stride=(1, 1), padding="SAME", groups=1, dilation=(1, 1)):
@@ -74,7 +79,9 @@ def max_pool2d(x_nhwc, window, stride, padding=(0, 0), ceil_mode=True):
     import os
 
     pads = _pool_pads(x_nhwc, window, stride, padding, ceil_mode)
-    if os.environ.get("PADDLE_TPU_EQUALITY_POOL_GRAD"):
+    mode = _flags.get_flag("pool_grad_mode")
+    if os.environ.get("PADDLE_TPU_EQUALITY_POOL_GRAD") or mode == "equality" \
+            or (mode == "hybrid" and tuple(stride) == (1, 1)):
         return _max_pool_padded(x_nhwc, tuple(window), tuple(stride),
                                 tuple(pads))
     # XLA select_and_scatter stays the default: a one-pass Pallas

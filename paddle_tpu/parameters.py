@@ -34,7 +34,11 @@ class Parameters:
         from paddle_tpu.graph import LayerNode
 
         topo = topology_or_cost
-        if isinstance(topo, (LayerNode, list)):
+        from paddle_tpu.multi_network import MultiNetwork
+
+        if isinstance(topo, MultiNetwork):
+            topo = Topology(topo.costs)
+        elif isinstance(topo, (LayerNode, list)):
             topo = Topology(topo)
         params = Parameters()
         params._specs = dict(topo.param_specs())

@@ -290,7 +290,12 @@ def convert_column(col, itype):
 
 
 def _densify(rows, itype):
-    """sparse ids / (id, value) pairs -> dense float32 rows."""
+    """sparse ids / (id, value) pairs -> dense float32 rows.
+
+    Duplicate ids SUM (the natural linear-algebra reading, and what the
+    SparseRows gather/weighted-sum path computes) so results agree on
+    both sides of sparse_feed_threshold; duplicate ids in one row are
+    malformed input either way."""
     if isinstance(rows, np.ndarray) and rows.ndim == 2:
         return rows.astype(np.float32)
     first = rows[0] if len(rows) else None
@@ -301,7 +306,7 @@ def _densify(rows, itype):
         for item in row:
             if isinstance(item, (tuple, list)):
                 idx, val = item
-                out[i, int(idx)] = float(val)
+                out[i, int(idx)] += float(val)
             else:
-                out[i, int(item)] = 1.0
+                out[i, int(item)] += 1.0
     return out if is_batch else out[0]

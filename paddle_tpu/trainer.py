@@ -41,7 +41,15 @@ class SGD:
                 "parameters must be a Parameters object")
         enforce(isinstance(update_equation, Optimizer),
                 "update_equation must be an Optimizer")
-        self.costs = [cost] if isinstance(cost, LayerNode) else list(cost)
+        from paddle_tpu.multi_network import MultiNetwork
+
+        if isinstance(cost, MultiNetwork):
+            # multi_nn parity: joint cost = sum_i w_i * mean(cost_i)
+            self.costs = list(cost.costs)
+            self._cost_weights = list(cost.weights)
+        else:
+            self.costs = [cost] if isinstance(cost, LayerNode) else list(cost)
+            self._cost_weights = [1.0] * len(self.costs)
         extra = [e for e in (extra_layers or [])]
         self.evaluators = [e for e in extra if getattr(e, "is_evaluator", False)]
         self.extra_outputs = [e for e in extra if not getattr(e, "is_evaluator", False)]
@@ -62,6 +70,7 @@ class SGD:
             n: s.attr for n, s in specs.items() if s is not None and not s.is_state
         }
         cost_names = [c.name for c in self.costs]
+        cost_weights = self._cost_weights
         eval_nodes = self.evaluators
 
         topo = self.topology
@@ -94,7 +103,8 @@ class SGD:
                 + [o.name for o in self.extra_outputs]
             values, updates = topo.apply(params, feed, mode=mode, rng=rng,
                                          outputs=wanted)
-            cost_total = sum(jnp.mean(values[c]) for c in cost_names)
+            cost_total = sum(w * jnp.mean(values[c])
+                             for c, w in zip(cost_names, cost_weights))
             eval_stats = {e.name: values[e.name] for e in eval_nodes}
             return cost_total, values, updates, eval_stats
 

@@ -147,3 +147,16 @@ def test_million_dim_ctr_trains_with_bounded_memory():
     # only touched rows may move (sparse_update=True row lifecycle)
     assert set(moved.tolist()) <= touched
     assert len(moved) > 0
+
+
+def test_duplicate_ids_sum_on_both_paths(force_sparse):
+    """Duplicate ids in one row must SUM identically through the sparse
+    path and the dense boundary conversion (threshold consistency)."""
+    from paddle_tpu.topology import _densify
+    from paddle_tpu import data_type as dtm
+
+    rows = [[5, 5, 9]]
+    dense = _densify(rows, dtm.sparse_binary_vector(16))
+    sr = SparseRows.from_rows(rows, 16, with_values=False)
+    np.testing.assert_array_equal(dense, np.asarray(sr.to_dense()))
+    assert dense[0, 5] == 2.0
