@@ -323,3 +323,151 @@ def build_image_step(model_name, batch, lr=0.01, dp_mesh=None):
                                host_batch=lambda i: cycle[i % len(cycle)],
                                train_flops=3 * topology_fwd_flops(topo,
                                                                   batch))
+
+
+def build_tagging_step(batch, seq_len=60, word_dict=30000, labels=67,
+                       emb=64, hidden=128, lr=2e-3, dp_mesh=None):
+    """North-star BiLSTM-CRF sequence tagger (BASELINE.json config 3;
+    reference: v1_api_demo/sequence_tagging rnn_crf.py over CoNLL-05)."""
+    import jax.numpy as jnp
+
+    _use_benchmark_precision()
+    from paddle_tpu import layer as L
+    from paddle_tpu import data_type as dt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models import text
+    from paddle_tpu.topology import Topology
+
+    reset_name_counters()
+    scores = text.sequence_tagging_rnn(word_dict_size=word_dict,
+                                       label_dict_size=labels,
+                                       emb_size=emb, hidden=hidden)
+    label = L.data(name="label", type=dt.integer_value_sequence(labels))
+    cost = L.crf(input=scores, label=label, name="tag_crf")
+    topo = Topology(cost)
+    optimizer = opt.Momentum(learning_rate=lr, momentum=0.9,
+                             slot_dtype=bench_slot_dtype())
+
+    def feed_of(words, lengths, tags):
+        return {"word": SequenceBatch(words, lengths),
+                "label": SequenceBatch(tags, lengths)}
+
+    rng = np.random.RandomState(0)
+    data = (
+        jnp.asarray(rng.randint(0, word_dict, (batch, seq_len)), jnp.int32),
+        jnp.full((batch,), seq_len, jnp.int32),
+        jnp.asarray(rng.randint(0, labels, (batch, seq_len)), jnp.int32),
+    )
+    cycle = [(rng.randint(0, word_dict, (batch, seq_len)).astype(np.int32),
+              np.full((batch,), seq_len, np.int32),
+              rng.randint(0, labels, (batch, seq_len)).astype(np.int32))
+             for _ in range(4)]
+    # 2 LSTM directions (proj emb->4h + recurrent h->4h per token, x2
+    # FLOPs/MAC) + score fc (2h -> labels) + CRF transitions O(L^2)/token
+    fwd = batch * seq_len * (2 * 2 * (emb * 4 * hidden
+                                      + hidden * 4 * hidden)
+                             + 2 * 2 * hidden * labels
+                             + 2 * labels * labels)
+    return _train_step_harness(topo, cost.name, optimizer, feed_of, data,
+                               dp_mesh=dp_mesh,
+                               host_batch=lambda i: cycle[i % len(cycle)],
+                               train_flops=3 * fwd)
+
+
+def build_seq2seq_step(batch, src_len=30, trg_len=30, dicts=30000,
+                       emb=512, hidden=512, lr=5e-4, dp_mesh=None):
+    """North-star attention NMT (BASELINE.json config 4; reference:
+    demo/seqToseq wmt14 config — emb/enc/dec 512, dict 30k)."""
+    import jax.numpy as jnp
+
+    _use_benchmark_precision()
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models import text
+    from paddle_tpu.topology import Topology
+
+    reset_name_counters()
+    cost, _ = text.seq2seq_attention(
+        src_dict_size=dicts, trg_dict_size=dicts,
+        emb_size=emb, enc_size=hidden, dec_size=hidden)
+    topo = Topology(cost)
+    optimizer = opt.Momentum(learning_rate=lr, momentum=0.9,
+                             slot_dtype=bench_slot_dtype())
+
+    def feed_of(src, slen, trg, trg_next, tlen):
+        return {"source_words": SequenceBatch(src, slen),
+                "target_words": SequenceBatch(trg, tlen),
+                "target_next_words": SequenceBatch(trg_next, tlen)}
+
+    rng = np.random.RandomState(0)
+
+    def host(i):
+        r = np.random.RandomState(i)
+        return (r.randint(2, dicts, (batch, src_len)).astype(np.int32),
+                np.full((batch,), src_len, np.int32),
+                r.randint(2, dicts, (batch, trg_len)).astype(np.int32),
+                r.randint(2, dicts, (batch, trg_len)).astype(np.int32),
+                np.full((batch,), trg_len, np.int32))
+
+    data = tuple(jnp.asarray(a) for a in host(0))
+    # encoder: 2 GRU dirs (emb->3h proj + h->3h recurrent per token);
+    # decoder per step: attention proj + gru-in fc ((2h+emb)->3h) +
+    # h->3h recurrent + output fc h->dict (dominates)
+    enc = src_len * 2 * (emb * 3 * hidden + hidden * 3 * hidden)
+    dec = trg_len * ((2 * hidden + emb) * 3 * hidden
+                     + hidden * 3 * hidden
+                     + hidden * dicts
+                     + 2 * hidden * hidden)  # attention projections
+    fwd = 2 * batch * (enc + dec)
+    return _train_step_harness(topo, cost.name, optimizer, feed_of, data,
+                               dp_mesh=dp_mesh, host_batch=host,
+                               train_flops=3 * fwd)
+
+
+def build_ctr_step(batch, sparse_dim=1_000_000, nnz=39, lr=1e-2,
+                   dp_mesh=None):
+    """North-star Wide&Deep CTR (BASELINE.json config 5): 1M-dim sparse
+    wide slot (SparseRows feed — the reference's go/pserver sparse-update
+    scale) + per-field embeddings and MLP. nnz=39 mirrors the classic
+    Criteo 39-feature rows."""
+    import jax.numpy as jnp
+
+    _use_benchmark_precision()
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core.sparse import SparseRows
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.recommender import wide_deep_ctr
+    from paddle_tpu.topology import Topology
+
+    reset_name_counters()
+    logit, label, cost = wide_deep_ctr(sparse_dim=sparse_dim,
+                                       field_dims=(1000, 1000, 100),
+                                       emb=16, hidden=(64, 32))
+    topo = Topology(cost)
+    optimizer = opt.Momentum(learning_rate=lr, momentum=0.9)
+
+    def feed_of(ids, f0, f1, f2, click):
+        return {"wide_features": SparseRows(ids, None, sparse_dim),
+                "field0": f0, "field1": f1, "field2": f2, "click": click}
+
+    rng = np.random.RandomState(0)
+
+    def mk(r):
+        return (r.randint(0, sparse_dim, (batch, nnz)).astype(np.int32),
+                r.randint(0, 1000, batch).astype(np.int32),
+                r.randint(0, 1000, batch).astype(np.int32),
+                r.randint(0, 100, batch).astype(np.int32),
+                r.randint(0, 2, (batch, 1)).astype(np.float32))
+
+    data = tuple(jnp.asarray(a) for a in mk(rng))
+    cycle = [mk(np.random.RandomState(i + 1)) for i in range(4)]
+    # compute is gather/MLP-bound: wide gather nnz*1 + 3 emb gathers +
+    # MLP (3*16 -> 64 -> 32 -> 1)
+    fwd = batch * 2 * (48 * 64 + 64 * 32 + 32 * 1 + nnz)
+    return _train_step_harness(topo, cost.name, optimizer, feed_of, data,
+                               dp_mesh=dp_mesh,
+                               host_batch=lambda i: cycle[i % len(cycle)],
+                               train_flops=3 * fwd)

@@ -31,8 +31,10 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
-from benchmark.harness import (achieved, build_image_step, build_rnn_step,
-                               chain_slope_ms, streamed_chain_slope_ms)
+from benchmark.harness import (achieved, build_ctr_step, build_image_step,
+                               build_rnn_step, build_seq2seq_step,
+                               build_tagging_step, chain_slope_ms,
+                               streamed_chain_slope_ms)
 
 # BASELINE.md ms/batch (reference K40m numbers)
 IMAGE_BASELINES = {
@@ -48,6 +50,18 @@ RNN_BASELINES = {
     (64, 256): 83, (64, 512): 184, (64, 1280): 641,
     (128, 256): 110, (128, 512): 261, (128, 1280): 1007,
     (256, 256): 170, (256, 512): 414, (256, 1280): 1655,
+}
+
+# BASELINE.json north-star configs 3-5 (no 2017 K40m table exists for
+# these; rows report samples/s + MFU, accuracy gates live in
+# tests/test_northstar_gates.py)
+NORTHSTAR = {
+    "tagging_bs32": lambda: build_tagging_step(32),
+    "tagging_bs128": lambda: build_tagging_step(128),
+    "nmt_bs16": lambda: build_seq2seq_step(16),
+    "nmt_bs64": lambda: build_seq2seq_step(64),
+    "ctr_bs512": lambda: build_ctr_step(512),
+    "ctr_bs2048": lambda: build_ctr_step(2048),
 }
 
 
@@ -67,6 +81,12 @@ def measure(build, repeats, n1, n2, stream_reps=2):
         if ms > 0.05:
             times.append(ms)
     best = min(times) if times else float("nan")
+    device_ms = None
+    if best == best and best < 2.0:
+        # sub-2ms rows: the wall slope measures the shared tunnel, not the
+        # chip (spread >100%); attach the profiler device-busy time as the
+        # chip truth (VERDICT r3 weak #4)
+        device_ms = _device_busy(bundle)
     stream = None
     if stream_reps:
         stimes = []
@@ -77,12 +97,35 @@ def measure(build, repeats, n1, n2, stream_reps=2):
                 stimes.append(ms)
         stream = min(stimes) if stimes else None
     tflops, mfu = achieved(bundle.train_flops, best)
-    return best, stream, tflops, mfu
+    return best, stream, tflops, mfu, device_ms
+
+
+def _device_busy(bundle, steps=40):
+    from benchmark import traceutil
+
+    state = {"c": bundle.carry}
+
+    def run():
+        for _ in range(steps):
+            state["c"] = bundle.step(state["c"])
+
+    try:
+        trace = traceutil.capture(run, lambda: bundle.fetch(state["c"]))
+    except Exception:
+        return None
+    finally:
+        # the donated carry is consumed by the first step: the stale one
+        # must never survive this call (deleted-buffer crash downstream)
+        bundle.carry = state["c"]
+    if trace is None or not trace.module_us:
+        return None
+    return trace.module_us / steps / 1000.0
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--suite", choices=("image", "rnn", "all", "gate"),
+    ap.add_argument("--suite",
+                    choices=("image", "rnn", "northstar", "all", "gate"),
                     default="rnn")
     ap.add_argument("--n1", type=int, default=5)
     ap.add_argument("--n2", type=int, default=35)
@@ -107,7 +150,7 @@ def main(argv=None):
 
     rows = []
 
-    def record(name, ms, stream, tflops, mfu, baseline):
+    def record(name, ms, stream, tflops, mfu, baseline, device_ms=None):
         vs = round(baseline / ms, 1) if baseline and ms == ms else None
         line = {"metric": name + "_train_ms_per_batch",
                 "value": round(ms, 3) if ms == ms else None,  # NaN -> null
@@ -115,18 +158,30 @@ def main(argv=None):
                 "streamed_ms": round(stream, 3) if stream else None,
                 "tflops": round(tflops, 1) if tflops else None,
                 "mfu_pct": round(mfu, 1) if mfu else None}
+        if device_ms:
+            line["device_ms"] = round(device_ms, 3)
+            if baseline:
+                line["device_vs_baseline"] = round(baseline / device_ms, 1)
         print(json.dumps(line), flush=True)
-        rows.append((name, ms, stream, tflops, mfu, baseline, vs))
+        rows.append((name, ms, stream, tflops, mfu, baseline, vs, device_ms))
 
     if args.suite in ("rnn", "all"):
         for (batch, hidden), base in RNN_BASELINES.items():
             name = "rnn_bs%d_h%d" % (batch, hidden)
             if only and name not in only:
                 continue
-            ms, stream, tflops, mfu = measure(
+            ms, stream, tflops, mfu, dev = measure(
                 lambda: build_rnn_step(batch, hidden), args.repeats,
                 args.n1, args.n2, args.stream_reps)
-            record(name, ms, stream, tflops, mfu, base)
+            record(name, ms, stream, tflops, mfu, base, dev)
+    if args.suite in ("northstar", "all"):
+        for name, build in NORTHSTAR.items():
+            if only and name not in only:
+                continue
+            ms, stream, tflops, mfu, dev = measure(
+                build, args.repeats, args.n1, max(13, args.n2 // 3),
+                args.stream_reps)
+            record(name, ms, stream, tflops, mfu, None, dev)
     if args.suite in ("image", "all"):
         for (model, batch), base in IMAGE_BASELINES.items():
             name = "%s_bs%d" % (model, batch)
@@ -134,18 +189,19 @@ def main(argv=None):
                 continue
             n2 = args.n2 if batch * (224 if model != "smallnet" else 32) \
                 < 64 * 224 * 4 else max(13, args.n2 // 3)
-            ms, stream, tflops, mfu = measure(
+            ms, stream, tflops, mfu, dev = measure(
                 lambda: build_image_step(model, batch), args.repeats,
                 args.n1, n2, args.stream_reps)
-            record(name, ms, stream, tflops, mfu, base)
+            record(name, ms, stream, tflops, mfu, base, dev)
 
-    print("\n%-18s %10s %10s %9s %7s %10s %8s"
-          % ("config", "ms/batch", "streamed", "TFLOP/s", "MFU%",
+    print("\n%-18s %10s %10s %9s %9s %7s %10s %8s"
+          % ("config", "ms/batch", "streamed", "device", "TFLOP/s", "MFU%",
              "baseline", "speedup"))
-    for name, ms, stream, tflops, mfu, base, vs in rows:
-        print("%-18s %10.3f %10s %9s %7s %10s %8s"
+    for name, ms, stream, tflops, mfu, base, vs, dev in rows:
+        print("%-18s %10.3f %10s %9s %9s %7s %10s %8s"
               % (name, ms,
                  "%.1f" % stream if stream else "-",
+                 "%.3f" % dev if dev else "-",
                  "%.1f" % tflops if tflops else "-",
                  "%.1f" % mfu if mfu else "-",
                  base if base else "-", vs if vs else "-"))
@@ -162,14 +218,18 @@ def _write_results(rows):
     def row_md(name, label):
         r = by_name.get(name)
         if r is None:
-            return "| %s | — | — | — | — | — | — |" % label
-        _, ms, stream, tflops, mfu, base, vs = r
+            return "| %s | — | — | — | — | — | — | — |" % label
+        _, ms, stream, tflops, mfu, base, vs, dev = r
         if ms != ms:  # NaN: every slope attempt was a tunnel artifact
-            return "| %s | (tunnel-noise) | — | — | — | %s | — |" % (
+            return "| %s | (tunnel-noise) | — | — | — | — | %s | — |" % (
                 label, base if base else "—")
-        return "| %s | %.2f | %s | %s | %s | %s | %s |" % (
+        dev_s = ("%.3f" % dev) if dev else "—"
+        if dev and base:
+            dev_s += " (%.0f×)" % (base / dev)
+        return "| %s | %.2f | %s | %s | %s | %s | %s | %s |" % (
             label, ms,
             ("%.1f" % stream) if stream else "—",
+            dev_s,
             ("%.1f" % tflops) if tflops else "—",
             ("%.1f%%" % mfu) if mfu else "—",
             base if base else "—",
@@ -202,12 +262,15 @@ def _write_results(rows):
         "against torch-shaped models UNDERSTATE this chip; MFU is the "
         "geometry-independent truth.",
         "",
-        "`speedup` = K40m baseline / resident ms.",
+        "`speedup` = K40m baseline / resident ms. *device* = profiler "
+        "device-busy ms/step, attached to sub-2ms rows where the wall "
+        "slope measures the shared tunnel, not the chip (VERDICT r3 "
+        "weak #4).",
         "",
         "## RNN: 2×LSTM + fc, IMDB schema, seq len 100 padded, dict 30k",
         "",
-        "| Config | ms/batch | streamed | TFLOP/s | MFU | K40m | speedup |",
-        "|---|---|---|---|---|---|---|",
+        "| Config | ms/batch | streamed | device | TFLOP/s | MFU | K40m | speedup |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for (batch, hidden), base in RNN_BASELINES.items():
         lines.append(row_md("rnn_bs%d_h%d" % (batch, hidden),
@@ -216,12 +279,22 @@ def _write_results(rows):
         "",
         "## CNN (train-mode step: dropout/LRN/BN live)",
         "",
-        "| Config | ms/batch | streamed | TFLOP/s | MFU | K40m | speedup |",
-        "|---|---|---|---|---|---|---|",
+        "| Config | ms/batch | streamed | device | TFLOP/s | MFU | K40m | speedup |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for (model, batch), base in IMAGE_BASELINES.items():
         lines.append(row_md("%s_bs%d" % (model, batch),
                             "%s bs %d" % (model, batch)))
+    lines += [
+        "",
+        "## North-star configs 3-5 (BASELINE.json; no 2017 K40m table — "
+        "accuracy gates: tests/test_northstar_gates.py)",
+        "",
+        "| Config | ms/batch | streamed | device | TFLOP/s | MFU | K40m | speedup |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for name in NORTHSTAR:
+        lines.append(row_md(name, name.replace("_", " ")))
     r50 = by_name.get("resnet50_bs128") or by_name.get("resnet50_bs64")
     if r50:
         sps = (128 if r50[0].endswith("128") else 64) / r50[1] * 1000.0

@@ -1,0 +1,184 @@
+"""Train-to-accuracy gates for the BASELINE.json north-star configs 3-5
+(VERDICT r3 next #4). Real CoNLL-05 / WMT-14 / Criteo data cannot be
+fetched on this zero-egress box (the dataset loaders fall back to
+synthetic corpora), so each gate trains on a STRUCTURED synthetic task
+whose bar a broken model cannot pass — the train_real_digits.py pattern
+with a documented synthetic bar:
+
+* tagging: labels are a deterministic function of the word id and its
+  left neighbor — a BiLSTM-CRF must reach <15% token error (majority
+  class is ~1/5, random is ~80% error);
+* NMT: target sequence is the source reversed over a small vocab — the
+  attention decoder must cut perplexity by >2x and beat 60% greedy
+  next-token accuracy;
+* CTR: click probability is a logistic function of 3 planted sparse
+  features — AUC must exceed 0.8 (random = 0.5).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import data_type as dt
+from paddle_tpu import layer as L
+from paddle_tpu import optimizer as opt
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.graph import reset_name_counters
+from paddle_tpu.models import text
+from paddle_tpu.parameters import Parameters
+
+
+def test_tagging_bilstm_crf_learns_synthetic_grammar():
+    vocab, labels, hidden = 80, 5, 48
+    reset_name_counters()
+    scores = text.sequence_tagging_rnn(word_dict_size=vocab,
+                                       label_dict_size=labels,
+                                       emb_size=24, hidden=hidden)
+    label = L.data(name="label", type=dt.integer_value_sequence(labels))
+    cost = L.crf(input=scores, label=label, name="gate_crf")
+    decoded = L.crf_decoding(input=scores, size=labels, name="gate_dec",
+                             param_attr=paddle.attr.ParamAttr(
+                                 name="gate_crf.w0"))
+    params = Parameters.create([cost, decoded])
+    trainer = paddle.trainer.SGD([cost], params,
+                                 opt.Adam(learning_rate=5e-3),
+                                 extra_layers=[decoded])
+
+    rng = np.random.RandomState(0)
+
+    def sample():
+        n = rng.randint(5, 12)
+        words = rng.randint(0, vocab, n)
+        tags = np.empty(n, np.int64)
+        tags[0] = words[0] % labels
+        for t in range(1, n):
+            tags[t] = (words[t] + words[t - 1]) % labels
+        return words.tolist(), tags.tolist()
+
+    batches = [[sample() for _ in range(32)] for _ in range(40)]
+    trainer.train(lambda: iter(batches), num_passes=4)
+
+    # token error of the Viterbi decode on fresh data
+    test = [sample() for _ in range(64)]
+    feed = [(w, t) for w, t in test]
+    from paddle_tpu.topology import Topology, convert_feed
+
+    topo = trainer.topology
+    fd = convert_feed(topo, feed)
+    trainer._sync_back()
+    import jax
+
+    vals, _ = Topology([decoded]).apply(
+        {n: params.get(n) for n in params.names()}, {
+            "word": fd["word"], "label": fd["label"]}, mode="test")
+    pred = vals["gate_dec"]
+    wrong = total = 0
+    data = np.asarray(pred.data)
+    for i, (w, t) in enumerate(test):
+        n = len(t)
+        wrong += int((data[i, :n] != np.asarray(t)).sum())
+        total += n
+    err = wrong / total
+    assert err < 0.15, "BiLSTM-CRF token error %.3f >= synthetic bar 0.15" \
+        % err
+
+
+def test_nmt_attention_learns_reversal():
+    vocab, emb, hidden = 40, 32, 48
+    reset_name_counters()
+    cost, _ = text.seq2seq_attention(src_dict_size=vocab,
+                                     trg_dict_size=vocab,
+                                     emb_size=emb, enc_size=hidden,
+                                     dec_size=hidden, bos_id=0, eos_id=1)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Adam(learning_rate=5e-3))
+    rng = np.random.RandomState(1)
+
+    def sample():
+        n = rng.randint(4, 9)
+        src = rng.randint(2, vocab, n).tolist()
+        rev = src[::-1]
+        return src, [0] + rev, rev + [1]
+
+    batches = [[sample() for _ in range(32)] for _ in range(30)]
+    losses = []
+    trainer.train(lambda: iter(batches), num_passes=5,
+                  event_handler=lambda e: losses.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    first = float(np.mean(losses[:10]))
+    last = float(np.mean(losses[-10:]))
+    # perplexity must fall by >2x on the reversal task
+    assert np.exp(first) / np.exp(last) > 2.0, (first, last)
+
+    # teacher-forced greedy next-token accuracy on fresh samples
+    from paddle_tpu.topology import convert_feed
+
+    test = [sample() for _ in range(64)]
+    trainer._sync_back()
+    fd = convert_feed(trainer.topology, test)
+    import jax
+    import jax.numpy as jnp
+
+    out_name = "nmt_decoder"
+    vals, _ = trainer.topology.apply(
+        {n: params.get(n) for n in params.names()}, fd, mode="test",
+        outputs=[out_name])
+    probs = vals[out_name]
+    pred = np.asarray(jnp.argmax(probs.data, axis=-1))
+    right = total = 0
+    for i, (_, _, nxt) in enumerate(test):
+        n = len(nxt)
+        right += int((pred[i, :n] == np.asarray(nxt)).sum())
+        total += n
+    acc = right / total
+    assert acc > 0.6, "greedy next-token accuracy %.3f <= 0.6 bar" % acc
+
+
+def test_ctr_wide_deep_reaches_auc():
+    from paddle_tpu.models.recommender import wide_deep_ctr
+
+    reset_name_counters()
+    dim = 200_000
+    logit, label, cost = wide_deep_ctr(sparse_dim=dim,
+                                       field_dims=(50, 50, 20), emb=8,
+                                       hidden=(32, 16))
+    params = Parameters.create([cost, logit])
+    trainer = paddle.trainer.SGD([cost], params,
+                                 opt.Adam(learning_rate=1e-2),
+                                 extra_layers=[logit])
+    rng = np.random.RandomState(2)
+    planted = rng.choice(dim, 3, replace=False)
+
+    def sample():
+        # planted ids carry a strong logit (+4 each over a -2 base) so the
+        # Bayes-optimal AUC of the generator is ~0.88 — the 0.8 bar is
+        # passable only by actually learning the planted wide rows
+        ids = sorted(set(rng.choice(dim, 8).tolist()))
+        if rng.rand() < 0.5:  # boosted planted frequency: signal exists
+            ids = sorted(set(ids + [int(planted[rng.randint(3)])]))
+        score = sum(4.0 for i in ids if i in set(planted)) - 2.0
+        p = 1.0 / (1.0 + np.exp(-score))
+        click = float(rng.rand() < p)
+        return (ids, int(rng.randint(50)), int(rng.randint(50)),
+                int(rng.randint(20)), [click])
+
+    batches = [[sample() for _ in range(64)] for _ in range(30)]
+    trainer.train(lambda: iter(batches), num_passes=3)
+
+    # AUC on fresh samples
+    from paddle_tpu.topology import convert_feed
+
+    test = [sample() for _ in range(512)]
+    trainer._sync_back()
+    fd = convert_feed(trainer.topology, test)
+    vals, _ = trainer.topology.apply(
+        {n: params.get(n) for n in params.names()}, fd, mode="test",
+        outputs=[logit.name])
+    scores = np.asarray(vals[logit.name]).reshape(-1)
+    y = np.array([s[-1][0] for s in test])
+    pos, neg = scores[y > 0], scores[y <= 0]
+    assert len(pos) and len(neg)
+    auc = (pos[:, None] > neg[None, :]).mean() \
+        + 0.5 * (pos[:, None] == neg[None, :]).mean()
+    assert auc > 0.8, "wide&deep AUC %.3f <= synthetic bar 0.8" % auc
