@@ -306,27 +306,34 @@ def _write_results(rows):
         ]
     lines += [
         "",
-        "## Methodology delta vs round 2",
+        "## Methodology (train-mode step since round 3)",
         "",
-        "Round 2 timed a test-mode forward + gradient (dropout skipped, BN "
-        "frozen, one resident batch) — VERDICT r2 weak #1. This table times "
-        "the train-mode graph trainer.py executes. Measured cost of the "
-        "honest graph at matched config (r2 value → r3 resident): ResNet-50 "
-        "bs64 2,709→≈2,400 samples/s (BN batch-stat + update passes), "
-        "AlexNet bs128 11.0→≈10.0 ms (dropout ~free; the round-3 "
-        "banded-matmul LRN paid for the train-mode extras), GoogleNet "
-        "19.1→≈20.5 ms. Round-3 perf work: LRN window-sum as a banded [C,C] "
-        "MXU matmul (3.0→0.73 ms on the conv1 map), batch-norm single-pass "
-        "fused statistics + hand-written 2-pass VJP (ResNet-50 +21%), "
-        "NHWC-resident activations between image layers.",
+        "Each row times the REAL training step — mode=train (dropout + BN "
+        "batch stats + moving-average updates, per-step rng), forward + "
+        "backward + momentum in one donated XLA program; bfloat16 compute, "
+        "f32 master params, bfloat16 optimizer moment slots (round 4 — "
+        "lockstep-vs-f32 guarded, tests/test_optimizers.py). The flagship "
+        "LSTM rows run the reference-parity PEEPHOLE cell (7h bias, round "
+        "4) through the fused Pallas kernels.",
         "",
-        "Known ceilings (profiled, not yet recovered): XLA conv kernels at "
-        "28×28/14×14 geometries reach only ~15-30 TF/s (vs 146 TF/s at "
-        "56×56) — the dominant ResNet-50/AlexNet residual; optimizer "
-        "momentum traffic on AlexNet's 61M f32 params is ~2.2ms/step of "
-        "pure HBM bandwidth. A Pallas max-pool backward was prototyped and "
-        "measured 3× slower than XLA select_and_scatter, so it was dropped "
-        "(ops/conv.py note).",
+        "Known ceilings — round-4 profiled attribution (this REVISES round "
+        "3's story): isolated XLA convs at the 28×28/14×14 geometries "
+        "reach 93-97% of bf16 peak in a chained fwd+bwd microbenchmark "
+        "(benchmark/exp_conv_taps.py) — conv lowering was NOT the "
+        "bottleneck. The in-model residual is (a) backward convs at ~37% "
+        "MFU concentrated in the small-channel large-spatial stages "
+        "(C=64 at 56×56 half-fills the 128-lane MXU), (b) max-pool "
+        "backward via select_and_scatter (5.1 ms/step of GoogleNet — "
+        "equality-compare and hybrid VJPs plus a Pallas kernel all "
+        "measured SLOWER, flags pool_grad_mode/ops notes), and (c) "
+        "weight-traffic-bound FC/optimizer passes (AlexNet fc6 alone has "
+        "a ~1.0 ms/step HBM floor from its 151MB f32 master). A shift-GEMM "
+        "conv decomposition and a bf16 LRN band were built, measured "
+        "slower, and left gated off. AlexNet floor analysis: ideal "
+        "compute ≈4.4 ms + irreducible weight traffic ≈1.5 ms ≈ 6 ms "
+        "vs the 6.7 ms (50× K40m) goal — every remaining ms is conv-bwd/"
+        "pool/fusion overhead, so ~35× is where XLA-based execution "
+        "lands today.",
         "",
         "Sub-2ms configs (SmallNet small batches, flagship LSTM) are "
         "tunnel-dispatch-bound: profiler device-busy time for SmallNet "
