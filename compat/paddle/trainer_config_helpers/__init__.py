@@ -234,3 +234,23 @@ for _n, _c in (("exp", _act.Exp), ("log", _act.Log), ("abs", _act.Abs),
     _register_unary(_n, _c)
 
 layer_math = _LayerMath()
+
+# -- evaluators (reference: trainer_config_helpers/evaluators.py __all__) ---
+from paddle_tpu.evaluator import (  # noqa: F401
+    auc_evaluator,
+    chunk_evaluator,
+    classification_error_evaluator,
+    classification_error_printer_evaluator,
+    column_sum_evaluator,
+    ctc_error_evaluator,
+    detection_map_evaluator,
+    gradient_printer_evaluator,
+    maxframe_printer_evaluator,
+    maxid_printer_evaluator,
+    pnpair_evaluator,
+    precision_recall_evaluator,
+    seq_classification_error_evaluator,
+    seqtext_printer_evaluator,
+    sum_evaluator,
+    value_printer_evaluator,
+)
