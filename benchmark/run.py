@@ -82,11 +82,14 @@ def measure(build, repeats, n1, n2, stream_reps=2):
             times.append(ms)
     best = min(times) if times else float("nan")
     device_ms = None
-    if best == best and best < 2.0:
-        # sub-2ms rows: the wall slope measures the shared tunnel, not the
-        # chip (spread >100%); attach the profiler device-busy time as the
-        # chip truth (VERDICT r3 weak #4)
-        device_ms = _device_busy(bundle)
+    if best == best:
+        # EVERY row carries the profiler device-busy time: wall slopes on
+        # this tunnel are noisy in BOTH directions (short-chain minima can
+        # deflate 20% below device time — round-4 alexnet_bs128 7.4ms wall
+        # vs 9.6ms device), so device_ms is the chip truth (VERDICT r3
+        # weak #4 generalized)
+        device_ms = _device_busy(bundle,
+                                 steps=40 if best < 5.0 else 12)
     stream = None
     if stream_reps and best == best and best >= 2.0:
         # sub-2ms rows: a streamed slope on this tunnel is pure noise
@@ -337,6 +340,11 @@ def _write_results(rows):
         "vs the 6.7 ms (50× K40m) goal — every remaining ms is conv-bwd/"
         "pool/fusion overhead, so ~35× is where XLA-based execution "
         "lands today.",
+        "",
+        "Wall-slope caveat: on this tunnel the min-of-N slope can also "
+        "DEFLATE on short chains (round 4: alexnet bs128 wall 7.4 ms on "
+        "13-step slopes vs 9.6 ms device-busy truth); rows without a "
+        "*device* value carry that error bar.",
         "",
         "Sub-2ms configs (SmallNet small batches, flagship LSTM) are "
         "tunnel-dispatch-bound: profiler device-busy time for SmallNet "
