@@ -41,6 +41,49 @@ GATE_TOL = {"float32": 2e-3, "bfloat16": 8e-2}
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "660"))
 _T0 = time.monotonic()
 
+# Every emitted record is collected here and RE-EMITTED as the final lines
+# of the run (least important first, flagship last). The driver records
+# only the TAIL of bench output; round 4 printed headline rows first and
+# the audited BENCH_r04 record lost the ResNet/AlexNet/GoogleNet/h1280
+# rows to truncation (VERDICT r4 missing #3). With the full re-emission
+# the tail IS the complete record.
+_EMITTED = {}
+_EMIT_ORDER = []
+
+
+def _print(rec):
+    metric = rec.get("metric")
+    if metric:
+        if metric not in _EMITTED:
+            _EMIT_ORDER.append(metric)
+        _EMITTED[metric] = rec
+    print(json.dumps(rec), flush=True)
+
+
+# Tail priority: metrics re-emitted in this order, LAST = most important
+# (the driver's last-line parser takes the headline from the final line).
+# Metrics not listed re-emit first, in first-emission order.
+_TAIL_PRIORITY = [
+    "ctr_wide_deep_1m_sparse_train_samples_per_sec_bs512",
+    "nmt_attention_train_samples_per_sec_bs16",
+    "tagging_bilstm_crf_train_samples_per_sec_bs32",
+    "googlenet_train_ms_per_batch_bs128",
+    "lstm_text_cls_train_ms_per_batch_bs64_h1280",
+    "alexnet_train_ms_per_batch_bs128",
+    "resnet50_train_samples_per_sec_per_chip_bs64",
+    "lstm_text_cls_train_ms_per_batch_bs64_h256_seq100",
+]
+
+
+def _reemit_tail():
+    """Final lines of the run: EVERY record again, headline rows last."""
+    rest = [m for m in _EMIT_ORDER if m not in _TAIL_PRIORITY]
+    tail = [m for m in _TAIL_PRIORITY if m in _EMITTED]
+    for metric in rest + tail:
+        rec = dict(_EMITTED[metric])
+        rec["reemit"] = True
+        print(json.dumps(rec), flush=True)
+
 
 def _remaining():
     return BUDGET_S - (time.monotonic() - _T0)
@@ -297,33 +340,74 @@ def _device_busy_ms(bundle, steps=40):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def _emit(metric, stats, unit, baseline_ms=None, samples=None, extra=None):
+def _emit(metric, stats, unit, baseline_ms=None, samples=None, extra=None,
+          dev_ms=None):
     """Print the resident-data line and, when measured, the streamed
-    companion (same metric + '_streamed')."""
-    def line(name, st):
+    companion (same metric + '_streamed').
+
+    When a profiler device-busy time is available it LEADS: value,
+    vs_baseline, tflops and mfu_pct all come from device_ms, with the
+    wall slope demoted to wall_* secondary fields (VERDICT r4 weak #2 —
+    no published headline the prose has to disavow). MFU computed from a
+    wall slope that exceeds 100% is physically impossible (tunnel
+    min-of-N deflation) and is flagged instead of printed as truth."""
+    from benchmark.harness import achieved
+
+    def line(name, st, dev=None):
+        wall_ms = st["value_ms"]
+        if not dev and wall_ms < 0.02:
+            # a sub-20us wall slope is tunnel-degenerate (chained steps
+            # overlapped with the timing window), not a measurement —
+            # round-4 printed a 747000000x "speedup" from one of these
+            _print({"metric": name, "value": None, "unit": unit,
+                    "note": "degenerate wall slope %.4fms (tunnel); "
+                            "no device trace to fall back on" % wall_ms,
+                    "elapsed_s": round(time.monotonic() - _T0, 1)})
+            return
+        lead_ms = dev if dev else wall_ms
         if samples is not None:
-            value = round(samples / st["value_ms"] * 1000.0, 1)
-            vs = round(value / baseline_ms, 3) if baseline_ms else None
+            value = round(samples / lead_ms * 1000.0, 1)
+            vs = round((samples / lead_ms * 1000.0) / baseline_ms, 3) \
+                if baseline_ms else None
             med = round(samples / st["median_ms"] * 1000.0, 1)
         else:
-            value = round(st["value_ms"], 3)
-            vs = round(baseline_ms / value, 3) if baseline_ms else None
+            value = round(lead_ms, 3)
+            vs = round(baseline_ms / lead_ms, 3) if baseline_ms else None
             med = round(st["median_ms"], 3)
         rec = {"metric": name, "value": value, "unit": unit,
-               "vs_baseline": vs, "median": med,
+               "vs_baseline": vs,
+               "timing": "device" if dev else "wall",
                "repeats": st["reps"], "spread_pct": round(st["spread"], 1),
                "elapsed_s": round(time.monotonic() - _T0, 1)}
-        from benchmark.harness import achieved
-
-        tflops, mfu = achieved(stats.get("flops"), st["value_ms"])
+        if dev:
+            rec["device_ms"] = round(dev, 3)
+            rec["wall_ms"] = round(wall_ms, 3)
+            if baseline_ms:
+                rec["wall_vs_baseline"] = round(
+                    (samples / wall_ms * 1000.0) / baseline_ms
+                    if samples is not None else baseline_ms / wall_ms, 3)
+        else:
+            rec["median"] = med
+        tflops, mfu = achieved(st.get("flops") or stats.get("flops"),
+                               lead_ms)
         if tflops is not None:
-            rec["tflops"] = round(tflops, 1)
-            rec["mfu_pct"] = round(mfu, 1)
+            if mfu > 100.0 and not dev:
+                # wall min-of-N on the shared tunnel can deflate below the
+                # physical step time; never print impossible MFU as truth
+                rec["mfu_pct"] = None
+                rec["mfu_wall_raw_pct"] = round(mfu, 1)
+                rec["mfu_note"] = ("wall-deflated >100% (tunnel); "
+                                   "device trace unavailable this run")
+            else:
+                rec["tflops"] = round(tflops, 1)
+                rec["mfu_pct"] = round(min(mfu, 100.0), 1)
+                if mfu > 100.0:
+                    rec["mfu_note"] = "clamped from %.1f" % mfu
         if extra:
             rec.update(extra)
-        print(json.dumps(rec), flush=True)
+        _print(rec)
 
-    line(metric, stats)
+    line(metric, stats, dev=dev_ms)
     if "streamed" in stats:
         line(metric + "_streamed", stats["streamed"])
 
@@ -357,15 +441,14 @@ def _bandwidth_probe():
         t_big = best_ms(8 * 1024 * 1024)
         slope_s = (t_big - t_small) / 1000.0
         if slope_s <= 0:  # tunnel noise inverted the slope — no number
-            print(json.dumps({
+            _print({
                 "metric": "host_to_device_bandwidth", "value": None,
                 "unit": "MB/s", "fixed_cost_ms": round(t_small, 2),
                 "note": "slope 256KB->8MB came out non-positive (tunnel "
-                        "noise); no bandwidth estimate this run"}),
-                flush=True)
+                        "noise); no bandwidth estimate this run"})
             return
         mbps = (8 * 1024 * 1024 - 256 * 1024) / 1e6 / slope_s
-        print(json.dumps({
+        _print({
             "metric": "host_to_device_bandwidth", "value": round(mbps, 1),
             "unit": "MB/s", "fixed_cost_ms": round(t_small, 2),
             "note": "device_put slope 256KB->8MB, fresh random payloads, "
@@ -373,18 +456,16 @@ def _bandwidth_probe():
                     "streamed step sees); bounds every *_streamed row — on "
                     "real TPU hosts this link is PCIe-class, on the axon "
                     "tunnel it degrades ~100x once Execute() traffic "
-                    "starts"}), flush=True)
+                    "starts"})
     except Exception as exc:  # never sink the bench
-        print(json.dumps({"metric": "host_to_device_bandwidth",
-                          "value": None, "error": repr(exc)[:200]}),
-              flush=True)
+        _print({"metric": "host_to_device_bandwidth",
+                "value": None, "error": repr(exc)[:200]})
 
 
 def _skip(metric, why):
-    print(json.dumps({"metric": metric, "value": None,
-                      "note": "skipped: " + why,
-                      "elapsed_s": round(time.monotonic() - _T0, 1)}),
-          flush=True)
+    _print({"metric": metric, "value": None,
+            "note": "skipped: " + why,
+            "elapsed_s": round(time.monotonic() - _T0, 1)})
 
 
 def _scaling_extra(remaining):
@@ -414,63 +495,56 @@ def _scaling_extra(remaining):
         sc = json.loads(line)
         t1, tn = sc.get("t1_ms"), sc.get("tN_ms")
         factor = round(t1 / tn, 3) if t1 and tn else None
-        print(json.dumps({
+        _print({
             "metric": "smallnet_dp8_sharding_overhead_cpu_mesh",
             "value": factor, "unit": "t1/t8 at equal global batch",
             "vs_baseline": factor,
             "note": "single-core host; 1.0 = sharding adds no replicated "
-                    "work (virtual mesh validates program, not hardware)"}),
-            flush=True)
+                    "work (virtual mesh validates program, not hardware)"})
     except Exception as exc:  # scaling is auxiliary — never sink the bench
-        print(json.dumps({"metric": "smallnet_dp8_sharding_overhead_cpu_mesh",
-                          "value": None, "error": repr(exc)[:200]}),
-              flush=True)
+        _print({"metric": "smallnet_dp8_sharding_overhead_cpu_mesh",
+                "value": None, "error": repr(exc)[:200]})
 
 
 def main():
     from benchmark.harness import build_image_step, build_rnn_step
 
     gate = numeric_gate()
-    print(json.dumps(gate), flush=True)
+    _print(gate)
 
     # ---- headline resident rows FIRST (streamed columns deferred to the
     # extras section: each streamed CNN batch moves 38-77MB over a
-    # ~6.5MB/s tunnel = 6-12s/batch, which is what blew round 3's budget) -
-    st = _timed(lambda: build_image_step("resnet50", 64), streamed_repeats=0)
-    _emit("resnet50_train_samples_per_sec_per_chip_bs64", st, "samples/s",
-          baseline_ms=2000.0, samples=64.0)
+    # ~6.5MB/s tunnel = 6-12s/batch, which is what blew round 3's budget).
+    # Each row is wall-sloped AND device-traced; device time leads the
+    # published value (VERDICT r4 next #3). ------------------------------
+    def headline(metric, build, baseline_ms, samples=None, n2=45,
+                 trace_steps=20):
+        bundle = build()
+        st = _timed(lambda: bundle, n2=n2, streamed_repeats=0)
+        dev_ms = _device_busy_ms(bundle, steps=trace_steps)
+        _emit(metric, st, "samples/s" if samples else "ms/batch",
+              baseline_ms=baseline_ms, samples=samples, dev_ms=dev_ms)
+        return bundle
 
-    st = _timed(lambda: build_image_step("alexnet", 128), streamed_repeats=0)
-    _emit("alexnet_train_ms_per_batch_bs128", st, "ms/batch",
-          baseline_ms=334.0)
-
-    st = _timed(lambda: build_image_step("googlenet", 128), n2=25,
-                streamed_repeats=0)
-    _emit("googlenet_train_ms_per_batch_bs128", st, "ms/batch",
-          baseline_ms=1149.0)
-
-    st = _timed(lambda: build_rnn_step(batch=64, hidden=1280), n2=25,
-                streamed_repeats=0)
-    _emit("lstm_text_cls_train_ms_per_batch_bs64_h1280", st, "ms/batch",
-          baseline_ms=641.0)
+    resnet_bundle = headline(
+        "resnet50_train_samples_per_sec_per_chip_bs64",
+        lambda: build_image_step("resnet50", 64), 2000.0, samples=64.0)
+    headline("alexnet_train_ms_per_batch_bs128",
+             lambda: build_image_step("alexnet", 128), 334.0)
+    headline("googlenet_train_ms_per_batch_bs128",
+             lambda: build_image_step("googlenet", 128), 1149.0, n2=25)
+    headline("lstm_text_cls_train_ms_per_batch_bs64_h1280",
+             lambda: build_rnn_step(batch=64, hidden=1280), 641.0, n2=25)
 
     # ---- flagship LSTM + device-busy cross-check -------------------------
     flagship = build_rnn_step(batch=64, hidden=256)
     st = _timed(lambda: flagship, repeats=5, n1=10, n2=110,
                 streamed_repeats=0)
-    # profiler device-busy cross-check: at sub-ms steps the wall slope
-    # measures the tunnel (spread_pct >100%); the device time is the chip
+    # profiler device-busy: at sub-ms steps the wall slope measures the
+    # tunnel (spread_pct >100%); the device time is the chip
     dev_ms = _device_busy_ms(flagship)
-    extra = ({"device_ms": round(dev_ms, 3),
-              "device_vs_baseline": round(83.0 / dev_ms, 1)}
-             if dev_ms else None)
     _emit("lstm_text_cls_train_ms_per_batch_bs64_h256_seq100", st,
-          "ms/batch", baseline_ms=83.0, extra=extra)
-    # bind by VALUE: the extras below rebind st/extra (round-4 bug: the
-    # re-emitted headline once carried the CTR row's stats)
-    flagship_repeat = lambda st=st, extra=extra: _emit(
-        "lstm_text_cls_train_ms_per_batch_bs64_h256_seq100", st,
-        "ms/batch", baseline_ms=83.0, extra=extra)
+          "ms/batch", baseline_ms=83.0, dev_ms=dev_ms)
 
     # ---- budget-gated extras (each prints a skip note when the budget is
     # short, so the audited record says WHY a row is absent) --------------
@@ -490,29 +564,12 @@ def main():
              lambda: build_ctr_step(512), 512.0)):
         if _remaining() > 120:
             # these steps are sub-ms — wall slopes measure the tunnel
-            # (first run: spreads of 650-850%), so the published value is
-            # samples/s from the profiler DEVICE-busy time; the wall slope
-            # rides along for context
+            # (first run: spreads of 650-850%); the published value is
+            # samples/s from the profiler DEVICE-busy time
             bundle = build()
             wall = _timed(lambda: bundle, n1=3, n2=15, streamed_repeats=0)
             dev_ms = _device_busy_ms(bundle)
-            if dev_ms:
-                rec = {"metric": metric,
-                       "value": round(bsz / dev_ms * 1000.0, 1),
-                       "unit": "samples/s", "vs_baseline": None,
-                       "device_ms": round(dev_ms, 3),
-                       "wall_ms": round(wall["value_ms"], 3),
-                       "wall_spread_pct": round(wall["spread"], 1),
-                       "elapsed_s": round(time.monotonic() - _T0, 1)}
-                from benchmark.harness import achieved
-
-                tfl, mfu = achieved(bundle.train_flops, dev_ms)
-                if tfl is not None:
-                    rec["tflops"] = round(tfl, 1)
-                    rec["mfu_pct"] = round(mfu, 1)
-                print(json.dumps(rec), flush=True)
-            else:
-                _emit(metric, wall, "samples/s", samples=bsz)
+            _emit(metric, wall, "samples/s", samples=bsz, dev_ms=dev_ms)
         else:
             _skip(metric, "bench budget")
 
@@ -536,10 +593,9 @@ def main():
 
     # streamed ResNet: ~38.5MB/batch over the tunnel; slope needs 7 batches
     if _remaining() > 150:
-        bundle = build_image_step("resnet50", 64)
-        ms, _ = streamed_ms(bundle, n1=2, n2=4)
+        ms, _ = streamed_ms(resnet_bundle, n1=2, n2=4)
         out = _stats([ms])
-        out["flops"] = bundle.train_flops
+        out["flops"] = resnet_bundle.train_flops
         _emit("resnet50_train_samples_per_sec_per_chip_bs64_streamed", out,
               "samples/s", baseline_ms=2000.0, samples=64.0)
     else:
@@ -551,9 +607,10 @@ def main():
     else:
         _skip("smallnet_dp8_sharding_overhead_cpu_mesh", "bench budget")
 
-    # ---- re-emit the flagship as the very LAST line (the driver's
-    # last-line parser takes the headline from here) -----------------------
-    flagship_repeat()
+    # ---- final lines: re-emit EVERY collected record, headline rows last
+    # (the driver records only the output tail; after this block the tail
+    # IS the complete audited record, flagship on the very last line) ------
+    _reemit_tail()
 
 
 def streamed_ms(bundle, n1, n2):

@@ -68,7 +68,7 @@ def main(argv=None):
                                         (args.global_batch // n + 1) * n))
     step1, carry1, fetch1 = build_sharded_step(args.model,
                                                args.global_batch, 1)
-    t1, _ = chain_slope_ms(step1, carry1, fetch1, args.n1, args.n2)
+    t1, carry1 = chain_slope_ms(step1, carry1, fetch1, args.n1, args.n2)
 
     if n == 1:
         print(json.dumps({
@@ -80,7 +80,19 @@ def main(argv=None):
 
     stepN, carryN, fetchN = build_sharded_step(args.model,
                                                args.global_batch, n)
-    tN, _ = chain_slope_ms(stepN, carryN, fetchN, args.n1, args.n2)
+    tN, carryN = chain_slope_ms(stepN, carryN, fetchN, args.n1, args.n2)
+    # INTERLEAVED repeats, min-of-each: the serial t1-then-tN order let a
+    # host load spike during either window skew the ratio both ways
+    # (round-4 0.929 "regression" and a 1.365 outlier both reproduce
+    # under deliberate background load; min of alternating windows is
+    # the least-polluted pairing on a time-shared core)
+    t1s, tns = [t1], [tN]
+    for _ in range(2):
+        m, carry1 = chain_slope_ms(step1, carry1, fetch1, args.n1, args.n2)
+        t1s.append(m)
+        m, carryN = chain_slope_ms(stepN, carryN, fetchN, args.n1, args.n2)
+        tns.append(m)
+    t1, tN = min(t1s), min(tns)
     eff = t1 / tN / n
     print(json.dumps({
         "metric": "%s_dp_scaling_%ddev" % (args.model, n),

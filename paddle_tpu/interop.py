@@ -1,0 +1,213 @@
+"""Reference-checkpoint interop: the 2017 Parameter binary format.
+
+Reads and writes the reference's own save format so a model trained on
+the system being replaced can be imported here (and back):
+
+- **Binary layout** (paddle/parameter/Parameter.cpp:285-312, struct at
+  Parameter.h:245-252): a 16-byte little-endian header
+  ``{int32 version=0, uint32 valueSize=4, uint64 size}`` followed by
+  ``size`` raw float32 values.
+- **Containers**: the C++ trainer writes one file per parameter named by
+  the parameter (``dirname/__lstmemory_0__.w0``); the v2 Python API
+  (python/paddle/v2/parameters.py:267-283) writes a tar with one raw
+  entry per parameter plus a ``<name>.protobuf`` ParameterConfig
+  sidecar. Both are supported; our layer naming already matches the
+  reference's (``__fc_layer_0__.w0`` style), so names line up.
+- **LSTM gate-column remap**: the reference's native gate buffer order
+  is [candidate(in), input-gate, forget, output]
+  (hl_cpu_lstm.cuh:42-45); ours is [input, forget, candidate, output]
+  (ops/rnn.py:40). Every gate-blocked parameter — the lstmemory
+  recurrent weight (H,4H), its merged bias (first 4H of the 7H layout,
+  LstmLayer.cpp:32-61), and the 4H input projection feeding it (weight
+  columns + bias) — is block-permuted on import/export. The peephole
+  check tail [checkIg, checkFg, checkOg] (LstmLayer.cpp:59-61) already
+  matches our [pi, pf, po] order. GRU needs no remap (ops/rnn.py
+  gru_step follows hl_gpu_gru.cuh order natively).
+
+Import requires the target ``Parameters`` (shapes come from the
+topology, as in the reference's own load: Parameter.cpp:342-356
+validates header.size against the configured size).
+"""
+
+import os
+import struct
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.utils.error import enforce
+
+_HEADER = struct.Struct("<iIQ")  # int32 version, uint32 valueSize, uint64 size
+_FORMAT_VERSION = 0
+
+# block k of ours takes block REF_TO_TPU[k] of the reference's [g,i,f,o]
+_REF_TO_TPU = (1, 2, 0, 3)  # ours [i,f,g,o] <- ref [ig, fg, in, og]
+_TPU_TO_REF = (2, 0, 1, 3)  # inverse permutation
+
+
+def read_parameter(data):
+    """Parse one reference-format parameter blob -> flat float32 array."""
+    enforce(len(data) >= _HEADER.size, "reference parameter too short")
+    version, value_size, size = _HEADER.unpack(data[:_HEADER.size])
+    enforce(version == _FORMAT_VERSION,
+            "unsupported reference format version %d", version)
+    enforce(value_size == 4, "unsupported valueSize %d (only float32)",
+            value_size)
+    body = data[_HEADER.size:]
+    enforce(len(body) == size * 4,
+            "reference parameter payload is %d bytes, header says %d",
+            len(body), size * 4)
+    return np.frombuffer(body, dtype="<f4").copy()
+
+
+def write_parameter(arr):
+    """Serialize a flat array to the reference binary format (float32)."""
+    flat = np.ascontiguousarray(arr, dtype="<f4").reshape(-1)
+    return _HEADER.pack(_FORMAT_VERSION, 4, flat.size) + flat.tobytes()
+
+
+def _permute_gate_blocks(arr, perm, axis=-1):
+    """Permute the 4 equal gate blocks of ``arr`` along ``axis``."""
+    blocks = np.split(np.asarray(arr), 4, axis=axis)
+    return np.concatenate([blocks[k] for k in perm], axis=axis)
+
+
+def _remap_lstm(arr, gate_spec, perm):
+    """Remap one gate-blocked parameter. gate_spec = (kind, hidden);
+    kind 'cols' permutes the 4 H-wide blocks of the last dim, 'bias'
+    permutes the first 4H of a 4H/7H vector (the 3H peephole-check tail
+    is order-stable)."""
+    kind, hidden = gate_spec
+    arr = np.asarray(arr)
+    if kind == "cols":
+        return _permute_gate_blocks(arr, perm, axis=-1)
+    n = arr.shape[0]
+    if n == 7 * hidden:
+        gate, checks = arr[:4 * hidden], arr[4 * hidden:]
+        return np.concatenate([_permute_gate_blocks(gate, perm), checks])
+    enforce(n == 4 * hidden, "gate bias of size %d is neither 4H nor 7H "
+            "for H=%d", n, hidden)
+    return _permute_gate_blocks(arr, perm)
+
+
+def lstm_gate_params(topology):
+    """name -> ('cols'|'bias', hidden) for every gate-blocked parameter
+    in the topology: each lstmemory's recurrent weight + bias, and the
+    weights/bias of the projection layer feeding its 4H input."""
+    out = {}
+    for node in topology.nodes:
+        if node.layer_type != "lstmemory":
+            continue
+        hidden = node.size
+        for spec in node.param_specs:
+            shape = tuple(spec.shape)
+            if shape == (hidden, 4 * hidden):
+                out[spec.name] = ("cols", hidden)
+            elif shape in ((4 * hidden,), (7 * hidden,)):
+                out[spec.name] = ("bias", hidden)
+        proj = node.inputs[0] if node.inputs else None
+        if proj is not None and getattr(proj, "size", None) == 4 * hidden:
+            for spec in proj.param_specs:
+                shape = tuple(spec.shape)
+                if shape and shape[-1] == 4 * hidden:
+                    out[spec.name] = (("cols" if len(shape) > 1 else "bias"),
+                                      hidden)
+    return out
+
+
+def _gate_map(topology):
+    if topology is None:
+        return {}
+    from paddle_tpu.topology import Topology
+    if not isinstance(topology, Topology):
+        topology = Topology(topology)
+    return lstm_gate_params(topology)
+
+
+def _import_one(params, name, flat, gate_kind):
+    shape = params.get_shape(name)
+    enforce(flat.size == int(np.prod(shape)) if shape else flat.size == 1,
+            "size mismatch for %r: file has %d, parameter is %s",
+            name, flat.size, shape)
+    arr = flat.reshape(shape)
+    if gate_kind:
+        arr = _remap_lstm(arr, gate_kind, _REF_TO_TPU)
+    params.set(name, arr)
+
+
+def _export_one(params, name, gate_kind):
+    arr = params.get(name)
+    if gate_kind:
+        arr = _remap_lstm(arr, gate_kind, _TPU_TO_REF)
+    return write_parameter(arr)
+
+
+def import_reference_tar(f, parameters, topology=None, strict=True):
+    """Load a reference v2 ``to_tar`` checkpoint into ``parameters``.
+
+    Entries whose names match parameters are imported (gate-remapped per
+    ``topology``); ``strict`` additionally requires every non-sidecar tar
+    entry to land. Returns the list of imported names."""
+    gate = _gate_map(topology)
+    imported = []
+    tar = tarfile.open(fileobj=f, mode="r")
+    try:
+        for member in tar.getmembers():
+            if member.name.endswith(".protobuf"):
+                continue  # ParameterConfig sidecar; shapes come from us
+            if member.name not in parameters:
+                enforce(not strict,
+                        "reference tar entry %r has no matching parameter "
+                        "(pass strict=False to skip)", member.name)
+                continue
+            flat = read_parameter(tar.extractfile(member).read())
+            _import_one(parameters, member.name, flat, gate.get(member.name))
+            imported.append(member.name)
+    finally:
+        tar.close()
+    return imported
+
+
+def export_reference_tar(f, parameters, topology=None):
+    """Write ``parameters`` as a reference v2-compatible tar (raw binary
+    entries; no .protobuf sidecars — the reference's from_tar needs them,
+    its init_from_tar path and the C++ loader do not)."""
+    import io
+
+    gate = _gate_map(topology)
+    tar = tarfile.open(fileobj=f, mode="w")
+    try:
+        for name in parameters.names():
+            data = _export_one(parameters, name, gate.get(name))
+            info = tarfile.TarInfo(name=name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    finally:
+        tar.close()
+
+
+def import_reference_dir(dirname, parameters, topology=None):
+    """Load a C++-trainer save dir (one file per parameter, named by the
+    parameter — Parameter.cpp:279-283). Missing files are skipped, like
+    the reference's kMissParameterRand-tolerant loader; returns imported
+    names."""
+    gate = _gate_map(topology)
+    imported = []
+    for name in parameters.names():
+        path = os.path.join(dirname, name)
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as fh:
+            flat = read_parameter(fh.read())
+        _import_one(parameters, name, flat, gate.get(name))
+        imported.append(name)
+    return imported
+
+
+def export_reference_dir(dirname, parameters, topology=None):
+    """Write a C++-trainer-style save dir (one binary file per param)."""
+    gate = _gate_map(topology)
+    os.makedirs(dirname, exist_ok=True)
+    for name in parameters.names():
+        with open(os.path.join(dirname, name), "wb") as fh:
+            fh.write(_export_one(parameters, name, gate.get(name)))

@@ -127,6 +127,13 @@ def img_conv(input, filter_size, num_filters, name=None, num_channels=None,
             y = conv_ops.conv2d_transpose(
                 x, kernel, stride=(sh, sw),
                 padding=((ph, ph), (pw, pw)))
+        elif conv_ops.stem_s2d_eligible(c, fh, fw, sh, sw, ph, pw, groups,
+                                        dil, trans):
+            # space-to-depth stem dispatch: C_in<=4 strided convs rewrite
+            # to stride-1 with an s*s*C contraction axis (MXU-filling);
+            # bit-equivalent math, same parameter (ops/conv.py)
+            y = conv_ops.conv2d_stem_s2d(
+                x, kernel, stride=(sh, sw), padding=((ph, ph), (pw, pw)))
         else:
             y = conv_ops.conv2d(
                 x, kernel, stride=(sh, sw),

@@ -27,10 +27,18 @@ from paddle_tpu.ops import rnn as rnn_ops
 from paddle_tpu.utils.error import enforce
 
 
+# Default sentinel for gate_bias_attr: a dedicated object (not a string,
+# not None) so an explicit gate_bias_attr=None — a natural way to say
+# "default bias" — selects the SPLIT parameterization it names rather
+# than silently aliasing the merged default (advisor r4).
+MERGED_GATE_BIAS = object()
+
+
 @register_layer("lstmemory")
 def lstmemory(input, name=None, size=None, reverse=False, act=None,
               gate_act=None, state_act=None, bias_attr=None, param_attr=None,
-              use_peephole=None, gate_bias_attr="merged", layer_attr=None):
+              use_peephole=None, gate_bias_attr=MERGED_GATE_BIAS,
+              layer_attr=None):
     """LSTM over a pre-projected sequence (input.size == 4*size).
 
     reference: LstmLayer.cpp:LstmLayer (project_input done by prior layer);
@@ -45,7 +53,7 @@ def lstmemory(input, name=None, size=None, reverse=False, act=None,
     cell; ``use_peephole=False`` forces a legacy 4*size bias without
     peepholes.
 
-    ``gate_bias_attr`` other than the default "merged" selects the
+    ``gate_bias_attr`` other than the MERGED_GATE_BIAS default selects the
     recurrent-group SPLIT parameterization (reference networks.py
     lstmemory_group -> lstmemory_unit): the 4*size gate bias is its own
     parameter (the group's in-step mixed-layer bias, input_proj_bias_attr;
@@ -58,7 +66,10 @@ def lstmemory(input, name=None, size=None, reverse=False, act=None,
 
     name = name or auto_name("lstmemory")
     wspec = weight_spec(name, 0, (size, 4 * size), param_attr, fan_in=size)
-    split = gate_bias_attr != "merged"
+    # the literal string "merged" (the pre-round-5 documented default)
+    # stays accepted as an explicit spelling of the sentinel
+    split = (gate_bias_attr is not MERGED_GATE_BIAS
+             and gate_bias_attr != "merged")
     peephole = use_peephole is not False  # reference default: on with bias
     if split:
         gspec = bias_spec(name + "_proj", (4 * size,), gate_bias_attr)

@@ -168,7 +168,12 @@ class MultiNetworkTrainer:
             new_p, new_opt = optimizer.step(train_p, grads, opt_state, meta)
             return loss, new_p, updates, new_opt
 
-        return jax.jit(step, donate_argnums=(0, 2))
+        # No donation here on purpose: train_p/opt_state alias the live
+        # buffers in self._params / phase state, and a step that fails
+        # after dispatch would leave the trainer holding deleted arrays
+        # with no recovery path (advisor r4). Per-phase param dicts are
+        # small relative to the step cost, so the extra copies are noise.
+        return jax.jit(step)
 
     def _build_infer(self, topo, outputs):
         def infer(params, feed):

@@ -42,6 +42,95 @@ def conv2d(x_nhwc, w_hwio, stride=(1, 1), padding="SAME", groups=1, dilation=(1,
     )
 
 
+def _s2d_axis_geometry(length, kernel, stride, pad, out):
+    """Per-axis geometry of the space-to-depth rewrite: returns
+    (front_pad, total_padded_length, taps, shift) where ``taps`` is the
+    transformed kernel size over block positions and ``shift`` = d in
+    w2[t, q] = w[stride*t + q - d]."""
+    pf = -(-pad // stride) * stride  # pad rounded UP to a block multiple
+    d = pf - pad
+    taps = (kernel - 1 + d) // stride + 1
+    total = stride * (out - 1 + taps)  # VALID conv over blocks -> exactly out
+    return pf, total, taps, d
+
+
+def conv2d_stem_s2d(x_nhwc, w_hwio, stride, padding):
+    """Exact space-to-depth rewrite of a strided stem convolution.
+
+    The canonical TPU transform for the C_in=3 input convolution (the
+    MXU contracts 128 lanes; 3 channels fills 3): block the input by the
+    conv stride s — [N, H, W, C] -> [N, H/s, W/s, s*s*C] — and absorb
+    the stride into a rearranged kernel, so the conv becomes stride-1
+    with an s*s*C contraction axis. Bit-for-bit the same math: each
+    output tap o[n] = sum_k w[k] x[s*n - p + k] is regrouped by block
+    position q = (s*n - p + k) mod s into w2[t, q] = w[s*t + q - d]
+    (zero outside the original kernel), d = front-pad alignment. The
+    kernel rearrangement is traced from the ORIGINAL [fh, fw, c, F]
+    parameter, so parameter shapes, checkpoints and gradients are
+    unchanged — this is a pure execution-layout dispatch, like the
+    reference's ExpandConvLayer-vs-cudnn choice (ConvBaseLayer.cpp).
+    """
+    (sh, sw) = stride
+    ((ph, _), (pw, _)) = padding
+    n, h, w, c = x_nhwc.shape
+    fh, fw, _, f = w_hwio.shape
+    oh = (h + 2 * ph - fh) // sh + 1
+    ow = (w + 2 * pw - fw) // sw + 1
+    pfh, th_total, th, dh = _s2d_axis_geometry(h, fh, sh, ph, oh)
+    pfw, tw_total, tw, dw = _s2d_axis_geometry(w, fw, sw, pw, ow)
+    # a large front pad can make the nominal total shorter than the
+    # padded input; extend to cover (extra block positions slice away)
+    th_total = max(th_total, -(-(h + pfh) // sh) * sh)
+    tw_total = max(tw_total, -(-(w + pfw) // sw) * sw)
+
+    x = jnp.pad(x_nhwc, ((0, 0), (pfh, th_total - h - pfh),
+                         (pfw, tw_total - w - pfw), (0, 0)))
+    # blocks: [N, Mh, sh, Mw, sw, C] -> [N, Mh, Mw, sh*sw*C]
+    mh, mw = th_total // sh, tw_total // sw
+    x = x.reshape(n, mh, sh, mw, sw, c).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(n, mh, mw, sh * sw * c)
+
+    # kernel: embed w[kh, kw] at w2[th, qh, tw, qw] = w[sh*th+qh-dh, ...]
+    # via a zero-padded buffer so the gather is two static slices
+    wp = jnp.zeros((sh * th, sw * tw) + w_hwio.shape[2:], w_hwio.dtype)
+    wp = lax.dynamic_update_slice(
+        wp, w_hwio, (dh, dw) + (0,) * (w_hwio.ndim - 2))
+    wp = wp.reshape(th, sh, tw, sw, c, f).transpose(0, 2, 1, 3, 4, 5)
+    wp = wp.reshape(th, tw, sh * sw * c, f)
+
+    y = lax.conv_general_dilated(
+        x, wp, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=matmul_precision(),
+    )
+    return y[:, :oh, :ow, :]
+
+
+def stem_s2d_eligible(c, fh, fw, sh, sw, ph, pw, groups, dilation, trans):
+    """Auto-dispatch predicate: small-channel strided stems only — the
+    shapes where the plain conv strands most of the MXU's 128 contraction
+    lanes (C*fh*fw small) and the rewrite multiplies channels by s*s."""
+    mode = _flags.get_flag("conv_stem_s2d")
+    if mode == "off" or trans or groups != 1 or dilation != (1, 1):
+        return False
+    if mode == "on":
+        return sh == sw and sh >= 2
+    # measured on v5e (RESULTS.md): the 11x11/s4 AlexNet stem gains
+    # (s*s*C = 48 contraction lanes vs 3), but the 7x7/s2 ResNet/GoogleNet
+    # stem REGRESSES 27.2->35.2ms — XLA's native handling of the s2 stem
+    # was already fine and the s2d reshapes cost HBM traffic — so auto
+    # only fires when the rewrite fills at least a quarter of the MXU's
+    # 128 contraction lanes (s*s*C >= 32, i.e. stride-4 stems)
+    return (c <= 4 and sh == sw and sh >= 2 and fh >= sh and fw >= sw
+            and c * sh * sw >= 32)
+
+
+_flags.define_flag("conv_stem_s2d", "auto",
+                   "space-to-depth stem convs: auto (C_in<=4 and "
+                   "stride*stride*C_in>=32, i.e. stride-4 stems), on, off "
+                   "(trace-time flag)")
+
+
 def conv2d_transpose(x_nhwc, w_hwio, stride=(1, 1), padding="SAME"):
     return lax.conv_transpose(
         x_nhwc,
