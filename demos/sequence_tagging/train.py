@@ -53,7 +53,6 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=("linear_crf", "rnn_crf"),
                     default="rnn_crf")
-    ap.add_argument("--dict-size", type=int, default=5000)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--num-passes", type=int, default=3)
     ap.add_argument("--quick", action="store_true")
@@ -66,8 +65,12 @@ def main(argv=None):
         train_reader = reader_ops.firstn(train_reader, 32)
         test_reader = reader_ops.firstn(test_reader, 16)
 
-    label, scores, cost, decoded = build(args.model, args.dict_size,
-                                         NUM_LABELS)
+    # size the model from the dicts the readers actually emit ids for —
+    # with a real cached corpus these are the reference dict files (tens
+    # of thousands of words), synthetic otherwise (conll05 constants)
+    word_dict, _, label_dict = conll05.get_dict()
+    label, scores, cost, decoded = build(args.model, len(word_dict),
+                                         len(label_dict))
     params = Parameters.create(cost)
     trainer = paddle.trainer.SGD(cost, params,
                                  opt.Adam(learning_rate=2e-3))

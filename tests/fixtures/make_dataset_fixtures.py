@@ -1,0 +1,137 @@
+"""Generate the tiny checked-in dataset fixture archives.
+
+Each fixture is a REAL-format miniature of the reference dataset
+archive (same member layout, same encodings) so the loaders' real parse
+paths are exercised hermetically. Deterministic content — rerunning
+reproduces the same bytes (modulo tar/gzip timestamps, which are pinned
+to 0). Run from the repo root:
+
+    python tests/fixtures/make_dataset_fixtures.py
+"""
+
+import gzip
+import io
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _add_bytes(tar, name, data):
+    info = tarfile.TarInfo(name=name)
+    info.size = len(data)
+    info.mtime = 0
+    tar.addfile(info, io.BytesIO(data))
+
+
+def _gz(data):
+    buf = io.BytesIO()
+    with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as f:
+        f.write(data)
+    return buf.getvalue()
+
+
+def make_imdb(path):
+    """3 train docs + 2 test docs, aclImdb layout."""
+    docs = {
+        "aclImdb/train/pos/0_9.txt":
+            b"A wonderful, wonderful film. Truly great!",
+        "aclImdb/train/pos/1_8.txt":
+            b"Great acting and a wonderful story.",
+        "aclImdb/train/neg/0_2.txt":
+            b"Terrible. A boring, terrible mess...",
+        "aclImdb/test/pos/0_10.txt":
+            b"Wonderful! great fun.",
+        "aclImdb/test/neg/0_1.txt":
+            b"Boring and terrible.",
+    }
+    with tarfile.open(path, "w:gz") as tar:
+        for name, text in sorted(docs.items()):
+            _add_bytes(tar, name, text)
+
+
+def make_cifar10(path):
+    """2 train images + 1 test image, python-pickle batch layout."""
+    rng = np.random.RandomState(0)
+
+    def batch(n, seed):
+        r = np.random.RandomState(seed)
+        return {b"data": r.randint(0, 256, size=(n, 3072)).astype(np.uint8),
+                b"labels": [int(x) for x in r.randint(0, 10, size=n)]}
+
+    with tarfile.open(path, "w:gz") as tar:
+        _add_bytes(tar, "cifar-10-batches-py/data_batch_1",
+                   pickle.dumps(batch(2, 1), protocol=2))
+        _add_bytes(tar, "cifar-10-batches-py/test_batch",
+                   pickle.dumps(batch(1, 2), protocol=2))
+
+
+def make_conll05(archive_path, dict_dir):
+    """2 sentences (one with 2 predicates), conll05st-release layout +
+    the three dict text files."""
+    words1 = ["The", "cat", "chased", "the", "mouse", "yesterday"]
+    # columns: verb column then one bracket column per predicate
+    props1 = [
+        "-    (A0*",
+        "-    *)",
+        "chase (V*)",
+        "-    (A1*",
+        "-    *)",
+        "-    (AM-TMP*)",
+    ]
+    words2 = ["Dogs", "bark", "and", "cats", "meow"]
+    props2 = [
+        "-    (A0*)  *",
+        "bark (V*)  *",
+        "-    *     *",
+        "-    *     (A0*)",
+        "meow *     (V*)",
+    ]
+    words = "\n".join(words1) + "\n\n" + "\n".join(words2) + "\n\n"
+    props = "\n".join(props1) + "\n\n" + "\n".join(props2) + "\n\n"
+    with tarfile.open(archive_path, "w:gz") as tar:
+        _add_bytes(tar,
+                   "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                   _gz(words.encode()))
+        _add_bytes(tar,
+                   "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                   _gz(props.encode()))
+    vocab = sorted(set(words1 + words2))
+    labels = ["O", "B-V", "I-V", "B-A0", "I-A0", "B-A1", "I-A1",
+              "B-AM-TMP", "I-AM-TMP"]
+    verbs = ["chase", "bark", "meow"]
+    for fname, toks in (("wordDict.txt", vocab), ("verbDict.txt", verbs),
+                        ("targetDict.txt", labels)):
+        with open(os.path.join(dict_dir, fname), "w") as f:
+            f.write("\n".join(toks) + "\n")
+
+
+def make_wmt14(path):
+    """2 train pairs + 1 test pair + dicts, wmt14.tgz layout."""
+    src_vocab = ["<s>", "<e>", "<unk>", "le", "chat", "noir", "bonjour"]
+    trg_vocab = ["<s>", "<e>", "<unk>", "the", "black", "cat", "hello"]
+    train = ("le chat noir\tthe black cat\n"
+             "bonjour le chat\thello the cat\n")
+    test = "le chat\tthe cat\n"
+    with tarfile.open(path, "w:gz") as tar:
+        _add_bytes(tar, "wmt14/src.dict",
+                   ("\n".join(src_vocab) + "\n").encode())
+        _add_bytes(tar, "wmt14/trg.dict",
+                   ("\n".join(trg_vocab) + "\n").encode())
+        _add_bytes(tar, "wmt14/train/train", train.encode())
+        _add_bytes(tar, "wmt14/test/test", test.encode())
+
+
+def main():
+    make_imdb(os.path.join(HERE, "aclImdb_v1.tar.gz"))
+    make_cifar10(os.path.join(HERE, "cifar-10-python.tar.gz"))
+    make_conll05(os.path.join(HERE, "conll05st-tests.tar.gz"), HERE)
+    make_wmt14(os.path.join(HERE, "wmt14.tgz"))
+    print("fixtures written to", HERE)
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,8 @@ with a documented synthetic bar:
   features — AUC must exceed 0.8 (random = 0.5).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -182,3 +184,74 @@ def test_ctr_wide_deep_reaches_auc():
     auc = (pos[:, None] > neg[None, :]).mean() \
         + 0.5 * (pos[:, None] == neg[None, :]).mean()
     assert auc > 0.8, "wide&deep AUC %.3f <= synthetic bar 0.8" % auc
+
+
+# ---- real-data auto-upgrade (VERDICT r4 next #4) -------------------------
+# When genuine archives are in the dataset cache, the same gates train on
+# REAL data to the BASELINE.md bars; on a zero-egress box they skip (the
+# parse paths themselves are fixture-tested in tests/test_dataset_real.py).
+
+def _real_corpus(reader, minimum):
+    """Materialize up to ``minimum`` samples; None if the loader is on
+    its synthetic fallback or the corpus is fixture-sized."""
+    import itertools
+
+    from paddle_tpu.dataset import common as ds_common
+
+    if not os.path.isdir(ds_common.DATA_HOME):
+        return None
+    samples = list(itertools.islice(reader(), minimum))
+    return samples if len(samples) >= minimum else None
+
+
+def test_tagging_real_conll05_upgrade():
+    from paddle_tpu.dataset import common as ds_common, conll05
+
+    if conll05._real_files()[0] is None:
+        pytest.skip("no real CoNLL-05 archive + dicts cached "
+                    "(zero-egress box)")
+    corpus = _real_corpus(conll05.train(), 500)
+    if corpus is None:
+        pytest.skip("cached CoNLL-05 corpus is fixture-sized")
+    word_dict, _, label_dict = conll05.get_dict()
+    reset_name_counters()
+    scores = text.sequence_tagging_rnn(word_dict_size=len(word_dict),
+                                       label_dict_size=len(label_dict),
+                                       emb_size=32, hidden=64)
+    label = L.data(name="label",
+                   type=dt.integer_value_sequence(len(label_dict)))
+    cost = L.crf(input=scores, label=label, name="real_gate_crf")
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params, opt.Adam(learning_rate=5e-3))
+    losses = []
+    trainer.train(paddle.batch(lambda: iter(corpus), batch_size=32),
+                  num_passes=3,
+                  event_handler=lambda e: losses.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    first, last = float(np.mean(losses[:5])), float(np.mean(losses[-5:]))
+    assert last < first * 0.7, \
+        "real-data CRF loss %.3f -> %.3f (<30%% drop)" % (first, last)
+
+
+def test_nmt_real_wmt14_upgrade():
+    from paddle_tpu.dataset import common as ds_common, wmt14
+
+    if not os.path.exists(ds_common.data_path("wmt14", wmt14.ARCHIVE)):
+        pytest.skip("no real WMT-14 archive cached (zero-egress box)")
+    corpus = _real_corpus(wmt14.train(dict_size=2000), 500)
+    if corpus is None:
+        pytest.skip("cached WMT-14 corpus is fixture-sized")
+    reset_name_counters()
+    cost, _ = text.seq2seq_attention(src_dict_size=2000, trg_dict_size=2000,
+                                     emb_size=64, enc_size=64, dec_size=64,
+                                     bos_id=0, eos_id=1)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params, opt.Adam(learning_rate=5e-3))
+    losses = []
+    trainer.train(paddle.batch(lambda: iter(corpus), batch_size=25),
+                  num_passes=3,
+                  event_handler=lambda e: losses.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    first, last = float(np.mean(losses[:5])), float(np.mean(losses[-5:]))
+    assert last < first * 0.8, \
+        "real-data NMT loss %.3f -> %.3f (<20%% drop)" % (first, last)
