@@ -21,7 +21,6 @@ from paddle_tpu.models import text
 from paddle_tpu.parameters import Parameters
 from paddle_tpu.reader import decorator as reader_ops
 
-NUM_LABELS = 67
 
 
 def build(model, word_dict_size, label_dict_size):

@@ -125,7 +125,7 @@ def train(word_idx=None, synthetic_size=2048):
     path = _archive_path()
     if os.path.exists(path):
         return _real_reader(_TRAIN_POS, _TRAIN_NEG,
-                            word_idx or build_dict(), path)
+                            word_idx or word_dict(), path)
     size = len(word_idx) if word_idx else WORD_DICT_SIZE
     return _synthetic(synthetic_size, 0, size)
 
@@ -134,6 +134,6 @@ def test(word_idx=None, synthetic_size=512):
     path = _archive_path()
     if os.path.exists(path):
         return _real_reader(_TEST_POS, _TEST_NEG,
-                            word_idx or build_dict(), path)
+                            word_idx or word_dict(), path)
     size = len(word_idx) if word_idx else WORD_DICT_SIZE
     return _synthetic(synthetic_size, 3, size)

@@ -55,8 +55,11 @@ def _read_dicts(tar_path, dict_size):
 def _real_reader(tar_path, file_suffix, dict_size):
     """Reference reader_creator: members ending with ``file_suffix``,
     one tab-separated pair per line."""
+    # dicts parse ONCE at creator time (reference reader_creator parity);
+    # each pass re-reads only the pair data
+    src_dict, trg_dict = _read_dicts(tar_path, dict_size)
+
     def reader():
-        src_dict, trg_dict = _read_dicts(tar_path, dict_size)
         with tarfile.open(tar_path, mode="r") as f:
             names = [m.name for m in f if m.name.endswith(file_suffix)]
             for name in names:
