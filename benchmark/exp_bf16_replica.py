@@ -1,0 +1,143 @@
+"""bf16 read-replica experiment (VERDICT r5 #8): carry a bf16 copy of the
+master params, written in the same fused update as the optimizer's f32
+master write, and differentiate the loss w.r.t. the REPLICA.
+
+What it changes per step vs the baseline (topology casts f32->bf16 at
+apply time): the fwd/bwd passes stop re-reading the f32 masters
+(AlexNet: 61M params x4B = 244MB/step of re-read becomes a 122MB bf16
+read), and gradients materialize in bf16 (another ~122MB saved). The
+optimizer still runs f32 arithmetic on the f32 masters (grads upcast on
+read), so update semantics are unchanged up to bf16 gradient rounding —
+which the backward pass already had at every interior edge.
+
+Usage: python benchmark/exp_bf16_replica.py --model alexnet --batch 128
+"""
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def build_replica_step(model, batch):
+    import jax
+    import jax.numpy as jnp
+
+    from benchmark import harness
+    from paddle_tpu.core import dtype as dtype_mod
+    from paddle_tpu.optimizer import ParamPool
+    from paddle_tpu import data_type as dt
+    from paddle_tpu import layer as L, optimizer as opt
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models import vision
+    from paddle_tpu.topology import Topology
+    import numpy as np
+
+    harness._use_benchmark_precision()
+    reset_name_counters()
+    fn_name, kwargs, in_dim, classes = harness.IMAGE_MODELS[model]
+    out = getattr(vision, fn_name)(num_classes=classes, **kwargs)
+    label = L.data(name="label", type=dt.integer_value(classes))
+    cost = L.classification_cost(input=out, label=label)
+    topo = Topology(cost)
+    optimizer = opt.Momentum(learning_rate=0.01, momentum=0.9,
+                             slot_dtype=harness.bench_slot_dtype())
+
+    all_params = topo.init_params(jax.random.PRNGKey(0))
+    state_names = {n for n, s in topo.param_specs().items()
+                   if getattr(s, "is_state", False)}
+    state = {k: v for k, v in all_params.items() if k in state_names}
+    params = {k: v for k, v in all_params.items() if k not in state_names}
+    pool = ParamPool(params)
+    use_pool = pool.enabled() and ParamPool.compatible_with(optimizer)
+
+    rng_np = np.random.RandomState(0)
+    data = (jnp.asarray(rng_np.randn(batch, in_dim), jnp.float32),
+            jnp.asarray(rng_np.randint(0, classes, batch), jnp.int32))
+
+    cd = dtype_mod.compute_dtype()
+    assert cd is not None and cd != jnp.float32, \
+        "replica experiment requires a non-f32 compute dtype"
+
+    def to_replica(tree):
+        return jax.tree.map(dtype_mod.to_compute, tree)
+
+    def train_step(params, replica, state, opt_state, rng, images, labels):
+        rng, sub = jax.random.split(rng)
+
+        def loss_fn(r):
+            full = pool.expand(r) if use_pool else r
+            values, updates = topo.apply(
+                {**full, **state}, {"image": images, "label": labels},
+                mode="train", rng=sub)
+            return jnp.mean(values[cost.name]), updates
+
+        (loss, updates), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(replica)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        new_params, new_opt = optimizer.step(params, grads, opt_state)
+        new_state = {**state, **updates}
+        return loss, new_params, to_replica(new_params), new_state, \
+            new_opt, rng
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
+    if use_pool:
+        params = pool.compress(params)
+    opt_state = optimizer.init_state(params)
+    carry = (jnp.zeros(()), params, to_replica(params), state, opt_state,
+             jax.random.PRNGKey(1))
+    step = lambda c: jitted(c[1], c[2], c[3], c[4], c[5], *data)
+    return harness.StepBundle(step, carry, lambda c: float(c[0]), None,
+                              None, train_flops=None), topo
+
+
+def main():
+    import json
+
+    import numpy as np
+
+    from benchmark.harness import build_image_step, chain_slope_ms
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="alexnet")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--lockstep", type=int, default=20,
+                    help="compare first N losses to the baseline path")
+    args = ap.parse_args()
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    import bench
+
+    base = build_image_step(args.model, args.batch)
+    ms_b, carry = chain_slope_ms(base.step, base.carry, base.fetch,
+                                 n1=5, n2=30)
+    base.carry = carry
+    dev_b = bench._device_busy_ms(base, steps=20)
+
+    rep, _ = build_replica_step(args.model, args.batch)
+    ms_r, carry = chain_slope_ms(rep.step, rep.carry, rep.fetch, n1=5, n2=30)
+    rep.carry = carry
+    dev_r = bench._device_busy_ms(rep, steps=20)
+
+    # loss lockstep from fresh carries (same seed/data both paths)
+    base2 = build_image_step(args.model, args.batch)
+    rep2, _ = build_replica_step(args.model, args.batch)
+    lb, lr = [], []
+    cb, cr = base2.carry, rep2.carry
+    for _ in range(args.lockstep):
+        cb = base2.step(cb)
+        cr = rep2.step(cr)
+        lb.append(base2.fetch(cb))
+        lr.append(rep2.fetch(cr))
+    drift = float(np.max(np.abs(np.asarray(lb) - np.asarray(lr))
+                         / np.maximum(1e-6, np.abs(lb))))
+    print(json.dumps({
+        "model": args.model, "batch": args.batch,
+        "baseline_wall_ms": round(ms_b, 3),
+        "baseline_device_ms": round(dev_b, 3) if dev_b else None,
+        "replica_wall_ms": round(ms_r, 3),
+        "replica_device_ms": round(dev_r, 3) if dev_r else None,
+        "lockstep_steps": args.lockstep,
+        "max_rel_loss_drift": round(drift, 5)}))
+
+
+if __name__ == "__main__":
+    main()

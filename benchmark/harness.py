@@ -197,7 +197,15 @@ def _train_step_harness(topo, cost_name, optimizer, feed_of, data,
     pool = ParamPool(params)
     use_pool = pool.enabled() and ParamPool.compatible_with(optimizer)
 
-    def train_step(params, state, opt_state, rng, *data):
+    from paddle_tpu.core import dtype as dtype_mod
+
+    cd = dtype_mod.compute_dtype()
+    use_replica = cd is not None and cd != jnp.float32
+
+    def train_step(params, replica, state, opt_state, rng, *data):
+        # same step the SGD trainer runs (trainer.py): under mixed
+        # precision fwd/bwd read a bf16 replica of the f32 masters,
+        # refreshed inside the same fused update as the master write
         rng, sub = jax.random.split(rng)
 
         def loss_fn(p):
@@ -206,18 +214,24 @@ def _train_step_harness(topo, cost_name, optimizer, feed_of, data,
                                          mode="train", rng=sub)
             return jnp.mean(values[cost_name]), updates
 
-        (loss, updates), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+        (loss, updates), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            replica if replica is not None else params)
+        if replica is not None:
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         new_params, new_opt = optimizer.step(params, grads, opt_state)
         new_state = {**state, **updates}
-        return loss, new_params, new_state, new_opt, rng
+        new_replica = (jax.tree.map(dtype_mod.to_compute, new_params)
+                       if replica is not None else None)
+        return loss, new_params, new_replica, new_state, new_opt, rng
 
-    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
     if use_pool:
         # flat master-parameter pool: one fused optimizer update instead
         # of hundreds of tiny per-buffer kernels (ParamPool docstring)
         params = pool.compress(params)
     opt_state = optimizer.init_state(params)
+    replica = (jax.tree.map(dtype_mod.to_compute, params) if use_replica
+               else None)
     loss0 = jnp.zeros(())
     rng0 = jax.random.PRNGKey(1)
     if dp_mesh is not None:
@@ -226,11 +240,11 @@ def _train_step_harness(topo, cost_name, optimizer, feed_of, data,
         batch_sh = NamedSharding(dp_mesh, P("data"))
         repl = NamedSharding(dp_mesh, P())
         data = tuple(jax.device_put(d, batch_sh) for d in data)
-        params, state, opt_state, loss0, rng0 = jax.tree.map(
+        params, replica, state, opt_state, loss0, rng0 = jax.tree.map(
             lambda a: jax.device_put(a, repl),
-            (params, state, opt_state, loss0, rng0))
-    carry = (loss0, params, state, opt_state, rng0)
-    step_data = lambda c, d: jitted(c[1], c[2], c[3], c[4], *d)
+            (params, replica, state, opt_state, loss0, rng0))
+    carry = (loss0, params, replica, state, opt_state, rng0)
+    step_data = lambda c, d: jitted(c[1], c[2], c[3], c[4], c[5], *d)
     return StepBundle(lambda c: step_data(c, data), carry,
                       lambda c: float(c[0]), step_data, host_batch,
                       train_flops=train_flops)

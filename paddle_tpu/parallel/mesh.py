@@ -114,13 +114,14 @@ class DataParallel:
 
         jitted = jax.jit(
             train_step,
-            donate_argnums=(0, 2, 3),
+            donate_argnums=(0, 1, 3, 4),
             out_shardings=None,
         )
 
-        def run(trainable, static, state, opt_state, feed, rng):
+        def run(trainable, replica, static, state, opt_state, feed, rng):
             feed = self.shard_batch(feed)
-            return jitted(trainable, static, state, opt_state, feed, rng)
+            return jitted(trainable, replica, static, state, opt_state,
+                          feed, rng)
 
         return run
 

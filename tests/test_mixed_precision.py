@@ -105,3 +105,47 @@ def test_training_step_converges_under_policy():
         loss, params, state = step(params, state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+def test_bf16_replica_activation_guard():
+    """The read replica activates only when the compute dtype differs
+    from the f32 masters — an f32 compute override must NOT alias the
+    donated master buffers into a second donated argument."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.topology import Topology
+    from paddle_tpu.utils import flags
+
+    def build_trainer():
+        from paddle_tpu.graph import reset_name_counters
+
+        reset_name_counters()
+        x = paddle.layer.data(name="x",
+                              type=paddle.data_type.dense_vector(8))
+        out = paddle.layer.fc(input=x, size=4,
+                              act=paddle.activation.Softmax())
+        lbl = paddle.layer.data(name="label",
+                                type=paddle.data_type.integer_value(4))
+        cost = paddle.layer.classification_cost(input=out, label=lbl)
+        params = Parameters.create(Topology(cost))
+        return paddle.trainer.SGD(
+            cost, params, paddle.optimizer.Momentum(learning_rate=0.1,
+                                                    momentum=0.9))
+
+    old = flags.get_flag("compute_dtype")
+    try:
+        flags.set_flag("compute_dtype", "bfloat16")
+        tr = build_trainer()
+        assert tr._replica is not None
+        flags.set_flag("compute_dtype", "float32")
+        tr32 = build_trainer()
+        assert tr32._replica is None
+        # and the f32 path still trains (no duplicate-donation crash)
+        rng = np.random.RandomState(0)
+        batch = [(rng.randn(8).astype(np.float32), int(rng.randint(4)))
+                 for _ in range(4)]
+        tr32.train(lambda: iter([batch]), num_passes=1)
+    finally:
+        flags.set_flag("compute_dtype", old or "")
