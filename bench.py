@@ -35,10 +35,14 @@ GATE_TOL = {"float32": 2e-3, "bfloat16": 8e-2}
 # CNN/RNN table (VERDICT r3 weak #1). Every headline resident row now
 # prints before any optional extra (streamed columns, bandwidth probe,
 # virtual-mesh scaling), and each extra first checks the remaining budget.
-# 660s default: the headline core path costs ~455s cold (gate 2 compiles
-# ~120s + five model compiles), round 2's driver completed ~600s of bench
-# work, and the first extras (the north-star rows) need ~120s more.
-BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "660"))
+# 700s default: cold compiles are the cost driver (~60-130s per model on
+# the tunnel; ~680s worst observed for all rows) — but with the
+# persistent compilation cache (harness.enable_compile_cache, populated
+# by any prior run in this checkout) a rerun finishes every row in
+# ~455s. The per-row north-star guards below degrade gracefully and the
+# SIGTERM kill-tail preserves whatever was measured if the driver's own
+# timeout fires first.
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "700"))
 _T0 = time.monotonic()
 
 # Every emitted record is collected here and RE-EMITTED as the final lines
@@ -75,14 +79,44 @@ _TAIL_PRIORITY = [
 ]
 
 
+_TAIL_DONE = False
+
+
 def _reemit_tail():
     """Final lines of the run: EVERY record again, headline rows last."""
+    global _TAIL_DONE
+    _TAIL_DONE = True
     rest = [m for m in _EMIT_ORDER if m not in _TAIL_PRIORITY]
     tail = [m for m in _TAIL_PRIORITY if m in _EMITTED]
     for metric in rest + tail:
         rec = dict(_EMITTED[metric])
         rec["reemit"] = True
         print(json.dumps(rec), flush=True)
+
+
+def _install_kill_tail():
+    """If the driver kills the bench (round-3 recorded rc=124 from such a
+    kill), the tail re-emission is the entire audited record — flush it
+    from the SIGTERM/SIGINT handler so a timeout never erases the rows
+    already measured."""
+    import signal
+
+    def on_kill(signum, frame):
+        if not _TAIL_DONE:
+            # the signal may land mid-print: a bare newline first makes
+            # the tail self-delimiting even on a half-written line
+            print("", flush=True)
+            _print({"metric": "bench_killed", "value": signum,
+                    "unit": "signal",
+                    "elapsed_s": round(time.monotonic() - _T0, 1)})
+            _reemit_tail()
+        raise SystemExit(128 + signum)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, on_kill)
+        except (ValueError, OSError):
+            pass  # non-main thread / unsupported platform
 
 
 def _remaining():
@@ -507,8 +541,11 @@ def _scaling_extra(remaining):
 
 
 def main():
-    from benchmark.harness import build_image_step, build_rnn_step
+    from benchmark.harness import (build_image_step, build_rnn_step,
+                                   enable_compile_cache)
 
+    _install_kill_tail()
+    enable_compile_cache()
     gate = numeric_gate()
     _print(gate)
 
@@ -555,14 +592,17 @@ def main():
     from benchmark.harness import (build_ctr_step, build_seq2seq_step,
                                    build_tagging_step)
 
-    for metric, build, bsz in (
+    # per-row cost estimates (compile + timing + trace, seconds): a flat
+    # 120s guard let one slow googlenet compile skip ALL northstar rows
+    # (the cheap ctr row included) on a noisy-tunnel run
+    for metric, build, bsz, cost_s in (
             ("tagging_bilstm_crf_train_samples_per_sec_bs32",
-             lambda: build_tagging_step(32), 32.0),
+             lambda: build_tagging_step(32), 32.0, 60),
             ("nmt_attention_train_samples_per_sec_bs64",
-             lambda: build_seq2seq_step(64), 64.0),
+             lambda: build_seq2seq_step(64), 64.0, 110),
             ("ctr_wide_deep_1m_sparse_train_samples_per_sec_bs512",
-             lambda: build_ctr_step(512), 512.0)):
-        if _remaining() > 120:
+             lambda: build_ctr_step(512), 512.0, 50)):
+        if _remaining() > cost_s + 15:
             # these steps are sub-ms — wall slopes measure the tunnel
             # (first run: spreads of 650-850%); the published value is
             # samples/s from the profiler DEVICE-busy time

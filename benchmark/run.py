@@ -102,7 +102,9 @@ def measure(build, repeats, n1, n2, stream_reps=2):
             if ms > 0:
                 stimes.append(ms)
         stream = min(stimes) if stimes else None
-    tflops, mfu = achieved(bundle.train_flops, best)
+    # device time LEADS every published derived number (VERDICT r4 #3):
+    # wall slopes on this tunnel are noisy in both directions
+    tflops, mfu = achieved(bundle.train_flops, device_ms or best)
     return best, stream, tflops, mfu, device_ms
 
 
@@ -129,6 +131,9 @@ def _device_busy(bundle, steps=40):
 
 
 def main(argv=None):
+    from benchmark.harness import enable_compile_cache
+
+    enable_compile_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite",
                     choices=("image", "rnn", "northstar", "all", "gate"),
@@ -157,17 +162,18 @@ def main(argv=None):
     rows = []
 
     def record(name, ms, stream, tflops, mfu, baseline, device_ms=None):
-        vs = round(baseline / ms, 1) if baseline and ms == ms else None
+        lead = device_ms if device_ms else ms
+        vs = round(baseline / lead, 1) if baseline and lead == lead else None
         line = {"metric": name + "_train_ms_per_batch",
-                "value": round(ms, 3) if ms == ms else None,  # NaN -> null
+                "value": round(lead, 3) if lead == lead else None,
                 "unit": "ms/batch", "vs_baseline": vs,
+                "timing": "device" if device_ms else "wall",
                 "streamed_ms": round(stream, 3) if stream else None,
                 "tflops": round(tflops, 1) if tflops else None,
                 "mfu_pct": round(mfu, 1) if mfu else None}
         if device_ms:
             line["device_ms"] = round(device_ms, 3)
-            if baseline:
-                line["device_vs_baseline"] = round(baseline / device_ms, 1)
+            line["wall_ms"] = round(ms, 3) if ms == ms else None
         print(json.dumps(line), flush=True)
         rows.append((name, ms, stream, tflops, mfu, baseline, vs, device_ms))
 
@@ -201,13 +207,14 @@ def main(argv=None):
             record(name, ms, stream, tflops, mfu, base, dev)
 
     print("\n%-18s %10s %10s %9s %9s %7s %10s %8s"
-          % ("config", "ms/batch", "streamed", "device", "TFLOP/s", "MFU%",
+          % ("config", "ms/batch", "wall", "streamed", "TFLOP/s", "MFU%",
              "baseline", "speedup"))
     for name, ms, stream, tflops, mfu, base, vs, dev in rows:
+        lead = dev if dev else ms
         print("%-18s %10.3f %10s %9s %9s %7s %10s %8s"
-              % (name, ms,
+              % (name, lead,
+                 ("%.3f" % ms) if (dev and ms == ms) else "-",
                  "%.1f" % stream if stream else "-",
-                 "%.3f" % dev if dev else "-",
                  "%.1f" % tflops if tflops else "-",
                  "%.1f" % mfu if mfu else "-",
                  base if base else "-", vs if vs else "-"))
@@ -226,16 +233,15 @@ def _write_results(rows):
         if r is None:
             return "| %s | — | — | — | — | — | — | — |" % label
         _, ms, stream, tflops, mfu, base, vs, dev = r
-        if ms != ms:  # NaN: every slope attempt was a tunnel artifact
+        if ms != ms and not dev:  # every slope attempt was tunnel noise
             return "| %s | (tunnel-noise) | — | — | — | — | %s | — |" % (
                 label, base if base else "—")
-        dev_s = ("%.3f" % dev) if dev else "—"
-        if dev and base:
-            dev_s += " (%.0f×)" % (base / dev)
-        return "| %s | %.2f | %s | %s | %s | %s | %s | %s |" % (
-            label, ms,
+        lead = dev if dev else ms
+        lead_s = "%.2f" % lead + ("" if dev else " (wall)")
+        return "| %s | %s | %s | %s | %s | %s | %s | %s |" % (
+            label, lead_s,
+            ("%.2f" % ms) if (dev and ms == ms) else "—",
             ("%.1f" % stream) if stream else "—",
-            dev_s,
             ("%.1f" % tflops) if tflops else "—",
             ("%.1f%%" % mfu) if mfu else "—",
             base if base else "—",
@@ -268,14 +274,15 @@ def _write_results(rows):
         "against torch-shaped models UNDERSTATE this chip; MFU is the "
         "geometry-independent truth.",
         "",
-        "`speedup` = K40m baseline / resident ms. *device* = profiler "
-        "device-busy ms/step, attached to sub-2ms rows where the wall "
-        "slope measures the shared tunnel, not the chip (VERDICT r3 "
-        "weak #4).",
+        "`speedup` = K40m baseline / DEVICE ms (profiler device-busy "
+        "time — the chip truth; wall slopes on this tunnel are noisy in "
+        "both directions and are demoted to the *wall* column). Rows "
+        "with no device trace fall back to the wall slope, marked "
+        "'(wall)'. TFLOP/s and MFU derive from the device time too.",
         "",
         "## RNN: 2×LSTM + fc, IMDB schema, seq len 100 padded, dict 30k",
         "",
-        "| Config | ms/batch | streamed | device | TFLOP/s | MFU | K40m | speedup |",
+        "| Config | device ms/batch | wall | streamed | TFLOP/s | MFU | K40m | speedup |",
         "|---|---|---|---|---|---|---|---|",
     ]
     for (batch, hidden), base in RNN_BASELINES.items():
@@ -285,7 +292,7 @@ def _write_results(rows):
         "",
         "## CNN (train-mode step: dropout/LRN/BN live)",
         "",
-        "| Config | ms/batch | streamed | device | TFLOP/s | MFU | K40m | speedup |",
+        "| Config | device ms/batch | wall | streamed | TFLOP/s | MFU | K40m | speedup |",
         "|---|---|---|---|---|---|---|---|",
     ]
     for (model, batch), base in IMAGE_BASELINES.items():
@@ -296,14 +303,15 @@ def _write_results(rows):
         "## North-star configs 3-5 (BASELINE.json; no 2017 K40m table — "
         "accuracy gates: tests/test_northstar_gates.py)",
         "",
-        "| Config | ms/batch | streamed | device | TFLOP/s | MFU | K40m | speedup |",
+        "| Config | device ms/batch | wall | streamed | TFLOP/s | MFU | K40m | speedup |",
         "|---|---|---|---|---|---|---|---|",
     ]
     for name in NORTHSTAR:
         lines.append(row_md(name, name.replace("_", " ")))
     r50 = by_name.get("resnet50_bs128") or by_name.get("resnet50_bs64")
-    if r50:
-        sps = (128 if r50[0].endswith("128") else 64) / r50[1] * 1000.0
+    if r50 and (r50[7] or r50[1] == r50[1]):
+        lead_ms = r50[7] if r50[7] else r50[1]  # device leads, wall fallback
+        sps = (128 if r50[0].endswith("128") else 64) / lead_ms * 1000.0
         lines += [
             "",
             "ResNet-50 (north star): **%.0f samples/s/chip** at %s — "
@@ -322,24 +330,34 @@ def _write_results(rows):
         "LSTM rows run the reference-parity PEEPHOLE cell (7h bias, round "
         "4) through the fused Pallas kernels.",
         "",
-        "Known ceilings — round-4 profiled attribution (this REVISES round "
-        "3's story): isolated XLA convs at the 28×28/14×14 geometries "
-        "reach 93-97% of bf16 peak in a chained fwd+bwd microbenchmark "
-        "(benchmark/exp_conv_taps.py) — conv lowering was NOT the "
-        "bottleneck. The in-model residual is (a) backward convs at ~37% "
-        "MFU concentrated in the small-channel large-spatial stages "
-        "(C=64 at 56×56 half-fills the 128-lane MXU), (b) max-pool "
-        "backward via select_and_scatter (5.1 ms/step of GoogleNet — "
-        "equality-compare and hybrid VJPs plus a Pallas kernel all "
-        "measured SLOWER, flags pool_grad_mode/ops notes), and (c) "
-        "weight-traffic-bound FC/optimizer passes (AlexNet fc6 alone has "
-        "a ~1.0 ms/step HBM floor from its 151MB f32 master). A shift-GEMM "
-        "conv decomposition and a bf16 LRN band were built, measured "
-        "slower, and left gated off. AlexNet floor analysis: ideal "
-        "compute ≈4.4 ms + irreducible weight traffic ≈1.5 ms ≈ 6 ms "
-        "vs the 6.7 ms (50× K40m) goal — every remaining ms is conv-bwd/"
-        "pool/fusion overhead, so ~35× is where XLA-based execution "
-        "lands today.",
+        "Known ceilings — round-5 per-resolution attribution (full tables "
+        "+ composite floor analysis: "
+        "`benchmark/artifacts/resnet50_bs64_analysis.md`): joining "
+        "device-trace times to HLO metadata shows ResNet-50's residual "
+        "concentrated in the stage-1/2 convs (C=64 at 56×56 runs ~19% "
+        "MFU — 64 channels fill half the MXU's 128 lanes in every "
+        "fwd/bwd position; stages 3/4 run at the 93-97% isolated-conv "
+        "peak) plus ~5.7 ms of bandwidth-bound elementwise/BN/pool "
+        "passes over 103MB stage-1 grids. The composite best-case floor "
+        "is ≈20 ms ≈ 42% MFU, so the ≥45% goal is not reachable with "
+        "legal rewrites at these dims. Round-5 measures: space-to-depth "
+        "stem convs (exact rewrite, `ops/conv.py`) ship for stride-4 "
+        "stems (AlexNet 9.60→9.48 ms) but REGRESS the 7×7/s2 stem "
+        "27.2→35.2 ms (XLA re-chooses layouts model-wide — see the "
+        "`_s2d_on` profile artifact), so auto-dispatch requires "
+        "s·s·C≥32; the bf16 read-replica train step (fwd/bwd read a "
+        "bf16 copy of the f32 masters refreshed inside the fused "
+        "optimizer update, `trainer.py` + `benchmark/exp_bf16_replica"
+        ".py`) cuts AlexNet bs128 to 9.26 ms device (36×; <1% loss "
+        "drift over 20 lockstep steps) and closes the fc6 f32-re-read "
+        "floor named in round 4. NMT decoder: scan-suffix hoisting (the "
+        "vocab-softmax fc leaves the scan — one stacked [B·T,H]×[H,30k] "
+        "matmul instead of T thin ones, `layer/rnn_group.py`) takes "
+        "bs16 4.55→3.17 ms and bs64 to 6.3-6.6 ms (~20% MFU); the "
+        "remaining residual is the sequential attention+GRU recurrence "
+        "+ scan loop overhead (`benchmark/artifacts/nmt_bs64_analysis"
+        ".md`, incl. two cross-entropy variants measured slower and "
+        "reverted).",
         "",
         "Wall-slope caveat: on this tunnel the min-of-N slope can also "
         "DEFLATE on short chains (round 4: alexnet bs128 wall 7.4 ms on "
@@ -356,6 +374,17 @@ def _write_results(rows):
         "(paddle_tpu/parallel), validated on the virtual 8-device CPU mesh "
         "and the 2-process jax.distributed test; this environment exposes "
         "one physical chip.",
+        "",
+        "dp8 sharding-overhead probe (r4 0.962→0.929 \"regression\", "
+        "VERDICT r5 #7): attributed to HOST-LOAD skew, not a code change "
+        "— the probe timed t(1-dev) and t(8-dev) in serial windows on a "
+        "single time-shared core, so background load during either "
+        "window skews the ratio in either direction; reproduced both "
+        "directions this round (a 0.93-class reading and a 1.365 "
+        "outlier while a CPU job ran alongside). benchmark/scaling.py "
+        "now interleaves three t1/t8 measurement pairs and takes "
+        "min-of-each, which pairs the least-polluted windows (warm-cache "
+        "rerun: 0.962).",
         "",
     ]
     with open(path, "w") as f:
