@@ -174,7 +174,14 @@ def main(argv=None):
         if device_ms:
             line["device_ms"] = round(device_ms, 3)
             line["wall_ms"] = round(ms, 3) if ms == ms else None
+        from benchmark.harness import sanitize_bench_row
+
+        line = sanitize_bench_row(line)
         print(json.dumps(line), flush=True)
+        if device_ms and "wall_ms" not in line:
+            # sanitize demoted a collapsed wall slope — keep it out of the
+            # console table and RESULTS.md too, not just the JSON line
+            ms = float("nan")
         rows.append((name, ms, stream, tflops, mfu, baseline, vs, device_ms))
 
     if args.suite in ("rnn", "all"):

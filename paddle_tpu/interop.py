@@ -12,7 +12,13 @@ the system being replaced can be imported here (and back):
   (python/paddle/v2/parameters.py:267-283) writes a tar with one raw
   entry per parameter plus a ``<name>.protobuf`` ParameterConfig
   sidecar. Both are supported; our layer naming already matches the
-  reference's (``__fc_layer_0__.w0`` style), so names line up.
+  reference's (``__fc_layer_0__.w0`` style), so names line up. Export
+  writes the sidecars too — the reference's ``from_tar`` (and
+  ``init_from_tar``, which delegates to it, parameters.py:296-327)
+  enumerates parameters SOLELY from ``.protobuf`` entries, so a tar
+  without them loads zero parameters there (advisor r5). The sidecar is
+  a minimal hand-encoded proto2 ParameterConfig (name/size/dims; wire
+  format needs no protobuf runtime).
 - **LSTM gate-column remap**: the reference's native gate buffer order
   is [candidate(in), input-gate, forget, output]
   (hl_cpu_lstm.cuh:42-45); ours is [input, forget, candidate, output]
@@ -66,6 +72,96 @@ def write_parameter(arr):
     return _HEADER.pack(_FORMAT_VERSION, 4, flat.size) + flat.tobytes()
 
 
+# --- minimal proto2 wire format for the reference's ParameterConfig ------
+# (proto/ParameterConfig.proto). Only the fields the v2 tar reader needs to
+# enumerate and shape parameters: required name = 1 (string), required
+# size = 2 (uint64), repeated dims = 9 (uint64, unpacked — proto2 default).
+# Hand-encoded so interop needs no protobuf runtime; unknown fields on the
+# read side are skipped per the proto wire rules.
+
+def _varint(n):
+    out = bytearray()
+    n = int(n)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def encode_parameter_config(name, size, dims):
+    """Serialize a minimal reference ParameterConfig message."""
+    name_b = name.encode("utf-8")
+    out = b"\x0a" + _varint(len(name_b)) + name_b      # field 1, string
+    out += b"\x10" + _varint(size)                      # field 2, uint64
+    for d in dims:
+        out += b"\x48" + _varint(d)                     # field 9, uint64
+    return out
+
+
+def decode_parameter_config(data):
+    """Parse the fields we write (skipping unknown ones) ->
+    {"name": str, "size": int, "dims": [int, ...]}."""
+    out = {"name": None, "size": None, "dims": []}
+    i, n = 0, len(data)
+
+    def varint(i):
+        val, shift = 0, 0
+        while True:
+            enforce(i < n, "truncated ParameterConfig varint")
+            b = data[i]
+            val |= (b & 0x7F) << shift
+            i += 1
+            if not b & 0x80:
+                return val, i
+            shift += 7
+
+    while i < n:
+        key, i = varint(i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, i = varint(i)
+            if field == 2:
+                out["size"] = val
+            elif field == 9:
+                out["dims"].append(val)
+        elif wire == 2:
+            ln, i = varint(i)
+            enforce(i + ln <= n, "truncated ParameterConfig bytes field")
+            if field == 1:
+                out["name"] = data[i:i + ln].decode("utf-8")
+            i += ln
+        elif wire == 1:
+            i += 8
+        elif wire == 5:
+            i += 4
+        else:
+            enforce(False, "unsupported ParameterConfig wire type %d", wire)
+    enforce(out["name"] is not None and out["size"] is not None,
+            "ParameterConfig missing required name/size")
+    return out
+
+
+def read_tar_sidecars(f):
+    """Enumerate a checkpoint tar the way the reference's ``from_tar``
+    does — from the ``.protobuf`` sidecars alone — returning
+    {name: {"size": ..., "dims": [...]}}. Raw data entries are ignored;
+    a tar exported without sidecars yields {} (exactly the reference's
+    silent zero-parameter load this guards against)."""
+    out = {}
+    tar = tarfile.open(fileobj=f, mode="r")
+    try:
+        for member in tar.getmembers():
+            if not member.name.endswith(".protobuf"):
+                continue
+            cfg = decode_parameter_config(tar.extractfile(member).read())
+            out[cfg["name"]] = {"size": cfg["size"], "dims": cfg["dims"]}
+    finally:
+        tar.close()
+    return out
+
+
 def _permute_gate_blocks(arr, perm, axis=-1):
     """Permute the 4 equal gate blocks of ``arr`` along ``axis``."""
     blocks = np.split(np.asarray(arr), 4, axis=axis)
@@ -93,7 +189,16 @@ def _remap_lstm(arr, gate_spec, perm):
 def lstm_gate_params(topology):
     """name -> ('cols'|'bias', hidden) for every gate-blocked parameter
     in the topology: each lstmemory's recurrent weight + bias, and the
-    weights/bias of the projection layer feeding its 4H input."""
+    weights/bias of the projection layer feeding its 4H input.
+
+    The projection remap only applies when the lstmemory is the
+    projection's SOLE consumer: if the 4H output also fans out to another
+    layer, that consumer reads the un-permuted columns, so permuting the
+    projection's parameters on import/export would silently corrupt what
+    it computes (advisor r5). Fan-out projections are skipped with a
+    warning — their values round-trip byte-exact, un-remapped."""
+    from paddle_tpu.utils.logger import logger
+
     out = {}
     for node in topology.nodes:
         if node.layer_type != "lstmemory":
@@ -107,6 +212,17 @@ def lstm_gate_params(topology):
                 out[spec.name] = ("bias", hidden)
         proj = node.inputs[0] if node.inputs else None
         if proj is not None and getattr(proj, "size", None) == 4 * hidden:
+            consumers = [n.name for n in topology.nodes
+                         if proj in getattr(n, "inputs", ())]
+            if consumers != [node.name]:
+                logger.warning(
+                    "interop: projection %r feeds lstmemory %r but also "
+                    "fans out to %r — skipping its gate-column remap "
+                    "(the other consumer reads un-permuted columns); "
+                    "checkpoints for it exchange byte-exact, un-remapped",
+                    proj.name, node.name,
+                    [c for c in consumers if c != node.name])
+                continue
             for spec in proj.param_specs:
                 shape = tuple(spec.shape)
                 if shape and shape[-1] == 4 * hidden:
@@ -169,19 +285,31 @@ def import_reference_tar(f, parameters, topology=None, strict=True):
 
 
 def export_reference_tar(f, parameters, topology=None):
-    """Write ``parameters`` as a reference v2-compatible tar (raw binary
-    entries; no .protobuf sidecars — the reference's from_tar needs them,
-    its init_from_tar path and the C++ loader do not)."""
+    """Write ``parameters`` as a reference v2 ``to_tar``-compatible tar:
+    one raw binary entry per parameter PLUS a ``<name>.protobuf``
+    ParameterConfig sidecar (name/size/dims). The reference's readers —
+    ``from_tar`` and the ``init_from_tar`` wrapper over it — enumerate
+    parameters solely from the sidecars, so without them an exported tar
+    loads ZERO parameters there, silently (advisor r5; only the C++
+    per-file dir loader, export_reference_dir, skips sidecars)."""
     import io
 
     gate = _gate_map(topology)
     tar = tarfile.open(fileobj=f, mode="w")
+
+    def add(name, data):
+        info = tarfile.TarInfo(name=name)
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+
     try:
         for name in parameters.names():
             data = _export_one(parameters, name, gate.get(name))
-            info = tarfile.TarInfo(name=name)
-            info.size = len(data)
-            tar.addfile(info, io.BytesIO(data))
+            shape = parameters.get_shape(name)
+            size = int(np.prod(shape)) if shape else 1
+            add(name + ".protobuf",
+                encode_parameter_config(name, size, shape or (1,)))
+            add(name, data)
     finally:
         tar.close()
 

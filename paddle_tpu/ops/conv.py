@@ -30,6 +30,17 @@ _flags.define_flag("pool_grad_mode", "",
 
 
 def conv2d(x_nhwc, w_hwio, stride=(1, 1), padding="SAME", groups=1, dilation=(1, 1)):
+    # Lane-packed Pallas dispatch for the ResNet stage-1/2 hot shapes
+    # (C=64/128 convs underfill the MXU's 128 contraction lanes under XLA
+    # — the round-5 floor analysis' 10ms bucket). Shape-gated exactly like
+    # the conv2d_stem_s2d gate below: default "auto" fires only for shapes
+    # with a recorded on-chip A/B win (none yet -> XLA path untouched);
+    # PADDLE_TPU_PALLAS_CONV=on/off forces. See ops/pallas_conv.py.
+    from paddle_tpu.ops import pallas_conv
+
+    if pallas_conv.eligible(x_nhwc, w_hwio, stride, padding, groups,
+                            dilation):
+        return pallas_conv.conv2d_lane_packed(x_nhwc, w_hwio)
     return lax.conv_general_dilated(
         x_nhwc,
         w_hwio,

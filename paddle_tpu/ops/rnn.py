@@ -11,8 +11,9 @@ from sorting sequences by length and shrinking the active batch.
 
 Gate layout here is [input, forget, cell(candidate), output] on the last
 axis. (The reference's native buffer order is [candidate, input, forget,
-output] — hl_cpu_lstm.cuh:42-45; importing a reference-trained checkpoint
-byte-for-byte would need a column remap, which nothing here does yet.)
+output] — hl_cpu_lstm.cuh:42-45; checkpoint interop performs exactly that
+gate-block column remap on import/export: paddle_tpu/interop.py
+_REF_TO_TPU / _TPU_TO_REF, golden-tested in tests/test_interop.py.)
 """
 
 from functools import partial

@@ -439,3 +439,32 @@ def test_equality_pool_grad_matches_native():
     g_n = jax.grad(loss_native)(x)
     np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_n),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_lstmemory_gate_bias_attr_none_selects_split():
+    """ADVICE r4 trap: an explicit ``gate_bias_attr=None`` (a natural
+    spelling of "default gate bias") must select the SPLIT
+    parameterization it names — its own 4*size gate-bias parameter plus a
+    3*size peephole-check bias — never silently alias the merged 7*size
+    default (layer/recurrent.py MERGED_GATE_BIAS sentinel)."""
+    from paddle_tpu.graph import reset_name_counters
+
+    def specs(**kw):
+        reset_name_counters()
+        x = L.data(name="x", type=dt.dense_vector_sequence(4 * 5))
+        node = L.lstmemory(input=x, size=5, name="cell", **kw)
+        return {s.name: tuple(s.shape) for s in node.param_specs}
+
+    merged = specs()  # default: one merged 7*size bias
+    assert merged == {"cell.w0": (5, 20), "cell.wbias": (35,)}
+
+    split = specs(gate_bias_attr=None)
+    assert split == {"cell.w0": (5, 20), "cell_proj.wbias": (20,),
+                     "cell.wbias": (15,)}
+
+    # the legacy literal "merged" stays an explicit spelling of the default
+    assert specs(gate_bias_attr="merged") == merged
+
+    # split with the gate bias disabled: peephole bias only
+    assert specs(gate_bias_attr=False) == {"cell.w0": (5, 20),
+                                           "cell.wbias": (15,)}

@@ -142,3 +142,36 @@ def test_multi_network_validates():
         MultiNetworkTrainer(
             MultiNetwork({"a": cost_a, "b": cost_b}),
             update_equations=opt.Momentum(learning_rate=0.1, momentum=0.9))
+
+
+def test_failed_step_leaves_trainer_recoverable():
+    """ADVICE r4 trap: _build_step deliberately does NOT donate the
+    param/opt-state buffers (multi_network.py) — a step that fails after
+    dispatch must leave the live store readable and training resumable.
+    Guards both halves: (1) pre-step buffer references stay valid after a
+    successful step (donation would delete them); (2) a failing batch
+    raises but the trainer keeps working afterwards."""
+    cost_a, cost_b = _two_task()
+    mn = MultiNetwork({"a": cost_a, "b": cost_b})
+    tr = MultiNetworkTrainer(
+        mn, update_equations=lambda: opt.Momentum(learning_rate=0.1,
+                                                  momentum=0.9))
+    batches = _batches()
+    feed_a = {"xa": 0, "ya": 1}
+
+    # (1) donation guard: old device buffers must survive the step
+    old = {n: tr._params[n] for n in tr._phases["a"]["train_names"]}
+    tr.train_batch("a", batches[0], feeding=feed_a)
+    for n, buf in old.items():
+        np.asarray(buf)  # donated-away buffers raise on read
+
+    # (2) failure recovery: a malformed batch (wrong feature width) fails,
+    # then the next good batch trains normally on intact state
+    bad = [(np.zeros(3, np.float32), 0, np.zeros(3, np.float32), 0)]
+    with pytest.raises(Exception):
+        tr.train_batch("a", bad, feeding=feed_a)
+    before = tr.get_params()
+    loss = tr.train_batch("a", batches[1], feeding=feed_a)
+    assert np.isfinite(loss)
+    after = tr.get_params()
+    assert any(not np.array_equal(before[n], after[n]) for n in before)
