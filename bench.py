@@ -331,53 +331,15 @@ def _device_busy_ms(bundle, steps=40):
     """Profiler-measured device-busy time per step — the chip truth for
     sub-ms configs where wall-clock slopes measure the shared tunnel, not
     the hardware (memory: SmallNet bs64 walls fluctuate 0.2-2ms while the
-    device runs 0.278ms). Returns None if the trace is unavailable."""
-    import collections
-    import glob
-    import gzip
-    import shutil
-    import tempfile
-
-    import jax
-
-    tmp = tempfile.mkdtemp(prefix="bench_trace_")
-    tracing = False
+    device runs 0.278ms). Returns None if the trace is unavailable.
+    The trace capture/parsing lives in paddle_tpu.observe.attribution
+    (the one place that holds the trace-layout knowledge)."""
     try:
-        carry = bundle.carry
-        jax.profiler.start_trace(tmp)
-        tracing = True
-        for _ in range(steps):
-            carry = bundle.step(carry)
-        bundle.fetch(carry)
-        jax.profiler.stop_trace()
-        tracing = False
-        bundle.carry = carry
-        files = glob.glob(tmp + "/**/*.trace.json.gz", recursive=True)
-        if not files:
-            return None
-        with gzip.open(files[0], "rt") as fh:
-            data = json.load(fh)
-        tracks = {}
-        for ev in data.get("traceEvents", []):
-            if ev.get("ph") == "M" and ev.get("name") == "thread_name":
-                tracks[(ev["pid"], ev["tid"])] = ev["args"].get("name")
-        busy = collections.Counter()
-        for ev in data.get("traceEvents", []):
-            if ev.get("ph") == "X" and "dur" in ev:
-                if tracks.get((ev.get("pid"), ev.get("tid"))) == "XLA Modules":
-                    busy["mod"] += ev["dur"]
-        if not busy["mod"]:
-            return None
-        return busy["mod"] / steps / 1000.0
+        from paddle_tpu.observe import attribution
+
+        return attribution.device_busy_ms(bundle, steps=steps)
     except Exception:
         return None
-    finally:
-        if tracing:  # a failed step must not leave the profiler running
-            try:
-                jax.profiler.stop_trace()
-            except Exception:
-                pass
-        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _emit(metric, stats, unit, baseline_ms=None, samples=None, extra=None,

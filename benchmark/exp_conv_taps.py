@@ -65,7 +65,7 @@ def chain_timed(step1, carry, calls=3):
     overhead is amortized too. Returns device ms per SINGLE conv step."""
     import jax
 
-    from benchmark import traceutil
+    from paddle_tpu.observe import attribution
 
     @jax.jit
     def stepN(carry):
@@ -78,7 +78,7 @@ def chain_timed(step1, carry, calls=3):
         for _ in range(calls):
             state["carry"] = stepN(state["carry"])
 
-    trace = traceutil.capture(run, lambda: float(state["carry"][-1]))
+    trace = attribution.capture(run, lambda: float(state["carry"][-1]))
     if trace is None or not trace.module_us:
         return float("nan")
     return trace.module_us / (calls * INNER) / 1000.0

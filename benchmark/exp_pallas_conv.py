@@ -13,8 +13,9 @@ which point the default "auto" mode starts taking the kernel for exactly
 those shapes. A losing shape stays on the XLA path and the checked-in
 table is the measurement artifact the VERDICT bar asks for.
 
-Timing: device-busy per step via the profiler (traceutil "XLA Modules"
-aggregation — the method bench.py trusts at sub-ms steps), INNER steps
+Timing: device-busy per step via the profiler (paddle_tpu.observe
+.attribution "XLA Modules" aggregation — the method bench.py trusts at
+sub-ms steps), INNER steps
 fused in one jitted scan, data-dependent carries (the chain_slope_ms
 discipline; see exp_conv_taps.py for why wall slopes are unusable here).
 
@@ -57,7 +58,7 @@ INNER = 24  # conv steps fused into one jitted scan per profiled call
 
 def chain_timed(step1, carry, calls=3):
     """Device-busy ms per single step (see exp_conv_taps.chain_timed)."""
-    from benchmark import traceutil
+    from paddle_tpu.observe import attribution
 
     @jax.jit
     def stepN(carry):
@@ -70,7 +71,7 @@ def chain_timed(step1, carry, calls=3):
         for _ in range(calls):
             state["carry"] = stepN(state["carry"])
 
-    trace = traceutil.capture(run, lambda: float(state["carry"][-1]))
+    trace = attribution.capture(run, lambda: float(state["carry"][-1]))
     if trace is None or not trace.module_us:
         return float("nan")
     return trace.module_us / (calls * INNER) / 1000.0

@@ -1,49 +1,38 @@
-"""Per-op profile of a real train-mode step: trace N steps of the
-harness-built bundle, aggregate device "X" events by op name, print the
-top-K with per-step ms — the tool for finding where the MFU residual
-actually lives (round-4 microbenchmarks showed isolated convs at 93-97%
-of peak, so the model-context fusions, not conv lowering, own the gap).
+"""Per-op profile of a real train-mode step — a thin caller of
+:mod:`paddle_tpu.observe.attribution` (which owns the trace parsing,
+op classification, HLO join, MXU estimates, and the dispatch-gap
+detector). Traces N steps of the harness-built bundle and prints the
+attribution report the `benchmark/artifacts/*_analysis.md` files are
+built from.
 
-Usage: python benchmark/exp_profile_model.py --model resnet50 --batch 64
+Usage:
+  python benchmark/exp_profile_model.py --model resnet50 --batch 64
+  python benchmark/exp_profile_model.py --model googlenet --batch 64 --hlo auto
+  python benchmark/exp_profile_model.py --rnn-hidden 512 --batch 64
+  python benchmark/exp_profile_model.py --northstar nmt_bs64     # dispatch-gap for NMT
+  python benchmark/exp_profile_model.py --northstar tagging_bs32 # ... and CRF
+  ... --write-artifact benchmark/artifacts/googlenet_bs64_analysis.md
 """
 
 import argparse
-import collections
-import re
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
-def profile_bundle(bundle, steps=10):
-    from benchmark import traceutil
+def build_bundle(args):
+    from benchmark.harness import build_image_step, build_rnn_step
 
-    state = {"carry": bundle.step(bundle.carry)}
-    bundle.fetch(state["carry"])  # compile + sync
+    if args.northstar:
+        from benchmark.run import NORTHSTAR
 
-    def run():
-        for _ in range(steps):
-            state["carry"] = bundle.step(state["carry"])
-
-    trace = traceutil.capture(run, lambda: bundle.fetch(state["carry"]))
-    bundle.carry = state["carry"]
-    if trace is None:
-        return None
-    return trace.per_op_us, trace.calls, trace.module_us, steps
-
-
-def classify(name):
-    n = name.lower()
-    for pat, tag in (
-            ("convolution", "conv"), ("conv_general", "conv"),
-            ("dot", "dot"), ("select-and-scatter", "pool_bwd"),
-            ("reduce-window", "pool"), ("all-reduce", "collective"),
-            ("copy", "copy"), ("transpose", "transpose"),
-            ("fusion", "fusion"), ("scatter", "scatter"),
-            ("dynamic-update", "dus"), ("reduce", "reduce")):
-        if pat in n:
-            return tag
-    return "other"
+        if args.northstar not in NORTHSTAR:
+            raise SystemExit("unknown --northstar %r (have: %s)"
+                             % (args.northstar, ",".join(sorted(NORTHSTAR))))
+        return NORTHSTAR[args.northstar]()
+    if args.rnn_hidden:
+        return build_rnn_step(batch=args.batch, hidden=args.rnn_hidden)
+    return build_image_step(args.model, args.batch)
 
 
 def main():
@@ -53,94 +42,50 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument("--hlo", default="",
-                    help="optimized HLO text (exp_dump_hlo) to join")
+                    help="optimized HLO text (exp_dump_hlo) to join; "
+                         "'auto' dumps this process's own program")
     ap.add_argument("--rnn-hidden", type=int, default=0,
                     help="profile the RNN bundle at this hidden size")
+    ap.add_argument("--northstar", default="",
+                    help="profile a north-star config from benchmark/run.py "
+                         "(e.g. nmt_bs64, tagging_bs32)")
+    ap.add_argument("--write-artifact", default="",
+                    help="also write the report to this path (e.g. "
+                         "benchmark/artifacts/<config>_analysis.md)")
     args = ap.parse_args()
 
-    from benchmark.harness import build_image_step, build_rnn_step
+    from paddle_tpu.observe import attribution
 
-    if args.rnn_hidden:
-        bundle = build_rnn_step(batch=args.batch, hidden=args.rnn_hidden)
-    else:
-        bundle = build_image_step(args.model, args.batch)
+    bundle = build_bundle(args)
+    hlo_defs = None
     if args.hlo == "auto":
         # dump the optimized HLO of THIS process's program so fusion names
         # are guaranteed to match the profiled run
         import jax
 
-        tag = ("rnn%d" % args.rnn_hidden) if args.rnn_hidden else args.model
+        tag = (args.northstar or
+               ("rnn%d" % args.rnn_hidden if args.rnn_hidden else args.model))
         args.hlo = "/tmp/hlo_%s_auto.txt" % tag
         txt = jax.jit(bundle.step).lower(bundle.carry).compile().as_text()
         open(args.hlo, "w").write(txt)
         print("dumped matching HLO to %s (%d bytes)" % (args.hlo, len(txt)))
-    res = profile_bundle(bundle, args.steps)
-    if res is None:
+    if args.hlo:
+        hlo_defs = attribution.load_hlo_defs(args.hlo)
+
+    trace = attribution.profile_bundle(bundle, args.steps)
+    if trace is None:
         print("no trace produced", file=sys.stderr)
         sys.exit(1)
-    per_op, n_call, mod_total, steps = res
-    total_ops = sum(per_op.values())
-    print("module total: %.3f ms/step | op total: %.3f ms/step  (%d steps)"
-          % (mod_total / steps / 1000.0, total_ops / steps / 1000.0, steps))
-    by_class = collections.Counter()
-    for name, dur in per_op.items():
-        by_class[classify(name)] += dur
-    print("\nby class (ms/step):")
-    for tag, dur in by_class.most_common():
-        print("  %-12s %8.3f  (%4.1f%%)"
-              % (tag, dur / steps / 1000.0, 100.0 * dur / total_ops))
-    print("\ntop ops (ms/step, calls/step):")
-    for name, dur in per_op.most_common(args.top):
-        print("  %8.3f  x%-4d %s"
-              % (dur / steps / 1000.0, n_call[name] // steps, name[:110]))
-    if args.hlo:
-        join_hlo(per_op, steps, args.hlo)
-
-
-# --- joiner: profile durations x HLO metadata (run after exp_dump_hlo) ----
-def join_hlo(per_op, steps, hlo_path, top=45):
-    """For each profiled op, find its HLO def line's metadata op_name and
-    output shape; print top ops with source attribution."""
-    import re as _re
-
-    defs = {}
-    pat = _re.compile(r'^\s*%?([\w.\-]+) = .*')
-    meta = _re.compile(r'op_name="([^"]+)"')
-    for line in open(hlo_path):
-        m = pat.match(line)
-        if not m or " = " not in line:
-            continue
-        name = m.group(1)
-        om = meta.search(line)
-        defs.setdefault(name, (om.group(1) if om else "?", line))
-    print("\ntop ops with HLO attribution (ms/step):")
-    agg = {}
-    for name, dur in per_op.most_common():
-        op_name = defs.get(name, ("?", ""))[0]
-        # compress jax op_name paths to the tail stages
-        tail = "/".join(op_name.split("/")[-2:])
-        agg[tail] = agg.get(tail, 0) + dur
-    for tail, dur in sorted(agg.items(), key=lambda kv: -kv[1])[:top]:
-        print("  %8.3f  %s" % (dur / steps / 1000.0, tail[:120]))
-
-    # conv-by-conv detail: measured ms vs the HLO cost model's estimate
-    shape_re = _re.compile(r'= \(?([a-z0-9]+)\[([\d,]+)\]')
-    cyc_re = _re.compile(r'"estimated_cycles":"(\d+)"')
-    rows = []
-    for name, dur in per_op.most_common():
-        op_name, line = defs.get(name, ("?", ""))
-        if "conv_general_dilated" not in op_name:
-            continue
-        sm = shape_re.search(line)
-        shape = ("%s[%s]" % sm.groups()) if sm else "?"
-        cm = cyc_re.search(line)
-        est_ms = int(cm.group(1)) / 940e6 * 1000.0 if cm else float("nan")
-        kind = "bwd" if "transpose" in op_name else "fwd"
-        rows.append((dur / steps / 1000.0, est_ms, kind, shape, name))
-    print("\nconv detail (measured ms | cost-model ms | kind | out shape):")
-    for ms, est, kind, shape, name in sorted(rows, reverse=True)[:32]:
-        print("  %7.3f | %7.3f | %s | %-28s %s"
-              % (ms, est, kind, shape, name[:40]))
+    report = attribution.report_text(
+        trace, args.steps, hlo_defs=hlo_defs, top=args.top,
+        flops_per_step=bundle.train_flops)
+    print(report)
+    if args.write_artifact:
+        header = "# Per-op device attribution — %s (%d steps)\n\n" % (
+            args.northstar or args.model, args.steps)
+        with open(args.write_artifact, "w") as fh:
+            fh.write(header + "```\n" + report + "\n```\n")
+        print("wrote", args.write_artifact)
 
 
 if __name__ == "__main__":

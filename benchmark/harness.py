@@ -16,6 +16,12 @@ import time
 
 import numpy as np
 
+from paddle_tpu.observe import spans as observe_spans
+# the peak constant and (TFLOP/s, MFU%) derivation live in ONE place —
+# paddle_tpu.observe.attribution — shared by bench.py, run.py and the
+# telemetry steplog; re-exported here for the existing import sites
+from paddle_tpu.observe.attribution import V5E_PEAK_TFLOPS, achieved  # noqa: F401
+
 
 def enable_compile_cache():
     """Persistent XLA compilation cache (verified working on the axon
@@ -66,14 +72,19 @@ def bench_slot_dtype():
 
 def chain_slope_ms(step, carry, fetch, n1=10, n2=110):
     """step: carry -> carry (jitted; each call data-depends on the last);
-    fetch: carry -> python scalar (host sync). Returns (ms_per_step, carry)."""
+    fetch: carry -> python scalar (host sync). Returns (ms_per_step, carry).
+
+    Each timed window is a ``bench_chain`` span (paddle_tpu.observe), so
+    the slope the BENCH row publishes and the telemetry/trace export are
+    the same measurement — they can never disagree."""
 
     def timed(iters, carry):
-        start = time.perf_counter()
-        for _ in range(iters):
-            carry = step(carry)
-        fetch(carry)
-        return time.perf_counter() - start, carry
+        with observe_spans.span("bench_chain",
+                                args={"iters": iters}) as scope:
+            for _ in range(iters):
+                carry = step(carry)
+            fetch(carry)
+        return scope.dur, carry
 
     carry = step(carry)  # warmup / compile
     fetch(carry)
@@ -108,13 +119,14 @@ def streamed_chain_slope_ms(bundle, n1=10, n2=110):
         return tuple(jax.device_put(x) for x in batch)
 
     def timed(iters, carry, base):
-        start = time.perf_counter()
-        nxt = put(base)
-        for i in range(iters):
-            cur, nxt = nxt, put(base + i + 1)  # prefetch next before compute
-            carry = bundle.step_data(carry, cur)
-        bundle.fetch(carry)
-        return time.perf_counter() - start, carry
+        with observe_spans.span("bench_chain_streamed",
+                                args={"iters": iters}) as scope:
+            nxt = put(base)
+            for i in range(iters):
+                cur, nxt = nxt, put(base + i + 1)  # prefetch before compute
+                carry = bundle.step_data(carry, cur)
+            bundle.fetch(carry)
+        return scope.dur, carry
 
     carry = bundle.step_data(bundle.carry, put(0))  # warmup / compile
     bundle.fetch(carry)
@@ -162,19 +174,6 @@ def sanitize_bench_row(rec):
     if notes:
         rec["sanity_note"] = "; ".join(notes)
     return rec
-
-
-V5E_PEAK_TFLOPS = 197.0  # bf16 peak of one v5e chip (MXU)
-
-
-def achieved(flops, ms):
-    """(TFLOP/s, MFU %) for a step of ``flops`` taking ``ms`` — the ONE
-    place the peak constant is applied (bench.py and run.py both report
-    these)."""
-    if not flops or not ms or ms != ms:
-        return None, None
-    tflops = flops / (ms / 1000.0) / 1e12
-    return tflops, tflops / V5E_PEAK_TFLOPS * 100.0
 
 
 def topology_fwd_flops(topo, batch, seq_len=1):

@@ -109,25 +109,9 @@ def measure(build, repeats, n1, n2, stream_reps=2):
 
 
 def _device_busy(bundle, steps=40):
-    from benchmark import traceutil
+    from paddle_tpu.observe import attribution
 
-    state = {"c": bundle.carry}
-
-    def run():
-        for _ in range(steps):
-            state["c"] = bundle.step(state["c"])
-
-    try:
-        trace = traceutil.capture(run, lambda: bundle.fetch(state["c"]))
-    except Exception:
-        return None
-    finally:
-        # the donated carry is consumed by the first step: the stale one
-        # must never survive this call (deleted-buffer crash downstream)
-        bundle.carry = state["c"]
-    if trace is None or not trace.module_us:
-        return None
-    return trace.module_us / steps / 1000.0
+    return attribution.device_busy_ms(bundle, steps=steps)
 
 
 def main(argv=None):
@@ -160,6 +144,23 @@ def main(argv=None):
         return
 
     rows = []
+    # PADDLE_TPU_TELEMETRY set → every published row is mirrored into the
+    # same JSONL sink the trainer writes (type=bench_row), so BENCH rows
+    # and telemetry can never disagree
+    from paddle_tpu.observe import steplog as observe_steplog
+
+    slog = observe_steplog.from_env(run_name="bench",
+                                    meta={"phase": "bench",
+                                          "suite": args.suite})
+    from paddle_tpu.observe import spans as observe_spans
+
+    tracer = observe_spans.get_tracer()
+    prev_recording = tracer.record_events
+    if slog is not None:
+        # telemetry may be flag-configured (no env var) — this run WILL
+        # export its bench spans, so force event recording on (restored
+        # in the finally below)
+        tracer.record_events = True
 
     def record(name, ms, stream, tflops, mfu, baseline, device_ms=None):
         lead = device_ms if device_ms else ms
@@ -178,56 +179,68 @@ def main(argv=None):
 
         line = sanitize_bench_row(line)
         print(json.dumps(line), flush=True)
+        if slog is not None:
+            slog.write(dict(line, type="bench_row"))
         if device_ms and "wall_ms" not in line:
             # sanitize demoted a collapsed wall slope — keep it out of the
             # console table and RESULTS.md too, not just the JSON line
             ms = float("nan")
         rows.append((name, ms, stream, tflops, mfu, baseline, vs, device_ms))
 
-    if args.suite in ("rnn", "all"):
-        for (batch, hidden), base in RNN_BASELINES.items():
-            name = "rnn_bs%d_h%d" % (batch, hidden)
-            if only and name not in only:
-                continue
-            ms, stream, tflops, mfu, dev = measure(
-                lambda: build_rnn_step(batch, hidden), args.repeats,
-                args.n1, args.n2, args.stream_reps)
-            record(name, ms, stream, tflops, mfu, base, dev)
-    if args.suite in ("northstar", "all"):
-        for name, build in NORTHSTAR.items():
-            if only and name not in only:
-                continue
-            ms, stream, tflops, mfu, dev = measure(
-                build, args.repeats, args.n1, max(13, args.n2 // 3),
-                args.stream_reps)
-            record(name, ms, stream, tflops, mfu, None, dev)
-    if args.suite in ("image", "all"):
-        for (model, batch), base in IMAGE_BASELINES.items():
-            name = "%s_bs%d" % (model, batch)
-            if only and name not in only:
-                continue
-            n2 = args.n2 if batch * (224 if model != "smallnet" else 32) \
-                < 64 * 224 * 4 else max(13, args.n2 // 3)
-            ms, stream, tflops, mfu, dev = measure(
-                lambda: build_image_step(model, batch), args.repeats,
-                args.n1, n2, args.stream_reps)
-            record(name, ms, stream, tflops, mfu, base, dev)
+    try:
+        if args.suite in ("rnn", "all"):
+            for (batch, hidden), base in RNN_BASELINES.items():
+                name = "rnn_bs%d_h%d" % (batch, hidden)
+                if only and name not in only:
+                    continue
+                ms, stream, tflops, mfu, dev = measure(
+                    lambda: build_rnn_step(batch, hidden), args.repeats,
+                    args.n1, args.n2, args.stream_reps)
+                record(name, ms, stream, tflops, mfu, base, dev)
+        if args.suite in ("northstar", "all"):
+            for name, build in NORTHSTAR.items():
+                if only and name not in only:
+                    continue
+                ms, stream, tflops, mfu, dev = measure(
+                    build, args.repeats, args.n1, max(13, args.n2 // 3),
+                    args.stream_reps)
+                record(name, ms, stream, tflops, mfu, None, dev)
+        if args.suite in ("image", "all"):
+            for (model, batch), base in IMAGE_BASELINES.items():
+                name = "%s_bs%d" % (model, batch)
+                if only and name not in only:
+                    continue
+                n2 = args.n2 if batch * (224 if model != "smallnet" else 32) \
+                    < 64 * 224 * 4 else max(13, args.n2 // 3)
+                ms, stream, tflops, mfu, dev = measure(
+                    lambda: build_image_step(model, batch), args.repeats,
+                    args.n1, n2, args.stream_reps)
+                record(name, ms, stream, tflops, mfu, base, dev)
 
-    print("\n%-18s %10s %10s %9s %9s %7s %10s %8s"
-          % ("config", "ms/batch", "wall", "streamed", "TFLOP/s", "MFU%",
-             "baseline", "speedup"))
-    for name, ms, stream, tflops, mfu, base, vs, dev in rows:
-        lead = dev if dev else ms
-        print("%-18s %10.3f %10s %9s %9s %7s %10s %8s"
-              % (name, lead,
-                 ("%.3f" % ms) if (dev and ms == ms) else "-",
-                 "%.1f" % stream if stream else "-",
-                 "%.1f" % tflops if tflops else "-",
-                 "%.1f" % mfu if mfu else "-",
-                 base if base else "-", vs if vs else "-"))
+        print("\n%-18s %10s %10s %9s %9s %7s %10s %8s"
+              % ("config", "ms/batch", "wall", "streamed", "TFLOP/s", "MFU%",
+                 "baseline", "speedup"))
+        for name, ms, stream, tflops, mfu, base, vs, dev in rows:
+            lead = dev if dev else ms
+            print("%-18s %10.3f %10s %9s %9s %7s %10s %8s"
+                  % (name, lead,
+                     ("%.3f" % ms) if (dev and ms == ms) else "-",
+                     "%.1f" % stream if stream else "-",
+                     "%.1f" % tflops if tflops else "-",
+                     "%.1f" % mfu if mfu else "-",
+                     base if base else "-", vs if vs else "-"))
 
-    if args.write_results:
-        _write_results(rows)
+        if args.write_results:
+            _write_results(rows)
+    finally:
+        # a mid-suite failure must still leave a usable telemetry dir:
+        # the trace export + end record mirror the trainer's finally
+        tracer.record_events = prev_recording
+        if slog is not None:
+            try:
+                observe_spans.export(slog.trace_path)
+            finally:
+                slog.close()
 
 
 def _write_results(rows):

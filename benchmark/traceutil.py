@@ -1,68 +1,13 @@
-"""Shared jax-profiler trace unpacking for the benchmark experiments.
+"""Compat shim — the shared trace-layout knowledge (pid/tid thread-name
+metadata map, "X" duration events, the "XLA Modules"/"XLA Ops" track
+names) was promoted into :mod:`paddle_tpu.observe.attribution` as part of
+the first-class observability subsystem. Import from there; this module
+keeps old callers working."""
 
-One place holds the trace-layout knowledge (pid/tid -> thread-name metadata
-map, "X" duration events, the "XLA Modules"/"XLA Ops" track names) so the
-experiment scripts can't drift apart on it.
-"""
-
-import collections
-import glob
-import gzip
-import json
-import shutil
-import tempfile
-
-
-class DeviceTrace:
-    """Parsed device-side durations from one profiler trace directory."""
-
-    def __init__(self, module_us, per_op_us, calls):
-        self.module_us = module_us    # total "XLA Modules" span time (us)
-        self.per_op_us = per_op_us    # Counter: op name -> total us
-        self.calls = calls            # Counter: op name -> #events
-
-    def module_ms_per(self, n):
-        return self.module_us / n / 1000.0 if self.module_us else None
-
-
-def capture(run_fn, sync_fn):
-    """Trace ``run_fn()`` (sync with ``sync_fn()`` before/after) and return
-    a DeviceTrace, or None if the backend produced no trace."""
-    import jax
-
-    sync_fn()
-    tmp = tempfile.mkdtemp(prefix="bench_trace_")
-    try:
-        jax.profiler.start_trace(tmp)
-        run_fn()
-        sync_fn()
-        jax.profiler.stop_trace()
-        files = glob.glob(tmp + "/**/*.trace.json.gz", recursive=True)
-        if not files:
-            return None
-        with gzip.open(files[0], "rt") as fh:
-            data = json.load(fh)
-    finally:
-        try:
-            jax.profiler.stop_trace()
-        except Exception:
-            pass
-        shutil.rmtree(tmp, ignore_errors=True)
-
-    tracks = {}
-    for ev in data.get("traceEvents", []):
-        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
-            tracks[(ev["pid"], ev["tid"])] = ev["args"].get("name")
-    module_us = 0.0
-    per_op = collections.Counter()
-    calls = collections.Counter()
-    for ev in data.get("traceEvents", []):
-        if ev.get("ph") != "X" or "dur" not in ev:
-            continue
-        tname = tracks.get((ev.get("pid"), ev.get("tid"))) or ""
-        if tname == "XLA Modules":
-            module_us += ev["dur"]
-        elif tname == "XLA Ops":
-            per_op[ev["name"]] += ev["dur"]
-            calls[ev["name"]] += 1
-    return DeviceTrace(module_us, per_op, calls)
+from paddle_tpu.observe.attribution import (  # noqa: F401
+    DeviceTrace,
+    capture,
+    device_busy_ms,
+    parse_trace_dir,
+    parse_trace_files,
+)

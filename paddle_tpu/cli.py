@@ -287,6 +287,42 @@ def cmd_merge_model(args):
     return 0
 
 
+def cmd_observe(args):
+    """Summarize a PADDLE_TPU_TELEMETRY directory: per-run step counts,
+    steady-state wall times, compile-event totals, and the trace files
+    to open in Perfetto (docs/observability.md)."""
+    from paddle_tpu.observe import steplog
+
+    summary = steplog.summarize_dir(args.directory)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+        return 0
+    print("telemetry dir: %s" % summary["directory"])
+    for run in summary["runs"]:
+        print("  run %-12s schema=%s backend=%-5s steps=%-5d "
+              "compile_events=%d (%.2fs)"
+              % (run.get("run"), run.get("schema"), run.get("backend"),
+                 run["steps"], run["compile_events"],
+                 run["event_secs_total"]))
+        if "wall_ms_steady_mean" in run:
+            print("    wall ms/step: steady mean %.3f  min %.3f  "
+                  "(first-step mean incl. compile %.3f)"
+                  % (run["wall_ms_steady_mean"], run["wall_ms_min"],
+                     run["wall_ms_mean"]))
+        if "examples_per_sec_best" in run:
+            print("    examples/sec best: %.1f"
+                  % run["examples_per_sec_best"])
+        if "cost_last" in run:
+            print("    cost: first %.6f -> last %.6f"
+                  % (run["cost_first"], run["cost_last"]))
+    if summary["trace_files"]:
+        print("  traces (open in https://ui.perfetto.dev): %s"
+              % ", ".join(summary["trace_files"]))
+    if not summary["runs"]:
+        print("  no *.steps.jsonl runs found")
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="paddle_tpu",
                                      description="paddle_tpu launcher")
@@ -327,6 +363,13 @@ def main(argv=None):
     p.add_argument("--devices-per-process", type=int, default=None,
                    help="virtual CPU devices per worker (testing)")
     p.set_defaults(fn=cmd_cluster_train)
+
+    p = sub.add_parser("observe")
+    p.add_argument("directory",
+                   help="telemetry directory (PADDLE_TPU_TELEMETRY)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable summary")
+    p.set_defaults(fn=cmd_observe)
 
     p = sub.add_parser("merge_model")
     p.add_argument("--config", default="")

@@ -1,0 +1,27 @@
+"""paddle_tpu.observe — spans, device attribution, step telemetry.
+
+The observability subsystem the rest of the stack instruments against
+(reference: paddle/utils/Stat.h REGISTER_TIMER registry, per-layer timers
+in gserver/NeuralNetwork.cpp:248, and the hl_profiler_start/end CUDA
+profiler window). Three pieces behind one package:
+
+* :mod:`paddle_tpu.observe.spans` — nested named host-side spans with
+  optional device sync, thread-safe, exportable as Chrome-trace/Perfetto
+  JSON, feeding the :class:`paddle_tpu.utils.stat.StatSet` aggregates.
+* :mod:`paddle_tpu.observe.attribution` — device-trace attribution
+  (promoted from benchmark/traceutil.py): per-op device time, fusion
+  grouping, MXU-utilization estimates, and the dispatch-gap detector that
+  flags scan/while-loop dispatch-bound regions.
+* :mod:`paddle_tpu.observe.steplog` — per-step JSONL telemetry sink with
+  a stable documented schema (docs/observability.md), activated by
+  ``PADDLE_TPU_TELEMETRY=<dir>``.
+
+Everything degrades to a no-op when profiling is unavailable: spans always
+work (pure host timing), attribution returns None without a usable
+profiler backend, and the steplog is simply not created without the env
+flag.
+"""
+
+from paddle_tpu.observe import attribution, spans, steplog  # noqa: F401
+from paddle_tpu.observe.spans import get_tracer, span  # noqa: F401
+from paddle_tpu.observe.steplog import StepLog, from_env, telemetry_dir  # noqa: F401

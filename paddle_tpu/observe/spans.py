@@ -1,0 +1,178 @@
+"""Nested named spans with Chrome-trace export.
+
+Host-side wall-time spans (reference: REGISTER_TIMER scopes,
+paddle/utils/Stat.h:230-233), kept deliberately cheap: a span is one
+``perf_counter`` pair plus an appended tuple, so the trainer can wrap
+every batch phase without measurable overhead. Each closed span also
+feeds the :data:`paddle_tpu.utils.stat.global_stats` StatSet under the
+span name, so ``PADDLE_TPU_STATS=1`` per-pass dumps and the exported
+trace can never disagree about what was measured.
+
+Export is the Chrome trace-event JSON format ("X" complete events, µs
+timestamps) — the file loads directly in Perfetto (ui.perfetto.dev) or
+chrome://tracing. Spans opened on different threads land on different
+trace rows; nesting within a thread is expressed by containment, which
+holds by construction (a nested span closes before its parent).
+
+An optional ``sync`` pytree is blocked on (``jax.block_until_ready``)
+before the span closes, so spans timing device work record real wall
+time, not dispatch time.
+"""
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+from paddle_tpu.utils.stat import global_stats
+
+
+class _Scope:
+    """Handle yielded by :meth:`SpanTracer.span`; ``dur`` (seconds) is set
+    when the span closes, so callers timing a window can reuse the span's
+    own measurement instead of keeping a second clock."""
+
+    __slots__ = ("name", "dur")
+
+    def __init__(self, name):
+        self.name = name
+        self.dur = None
+
+
+class SpanTracer:
+    """Thread-safe span recorder. One process-global instance
+    (:func:`get_tracer`) is shared by the trainer, the benchmark harness,
+    and user code; sub-tracers are only needed for isolated tests."""
+
+    MAX_EVENTS = 200_000  # hard cap; excess spans still feed stats
+
+    def __init__(self, name="paddle_tpu", stats=global_stats,
+                 record_events=True):
+        self.name = name
+        self.enabled = True
+        # record_events: True/False, or None = auto — record only while
+        # PADDLE_TPU_TELEMETRY is set (the process-global tracer uses
+        # auto so a run with no possible trace consumer doesn't retain up
+        # to MAX_EVENTS tuples in memory; consumers that WILL export —
+        # the trainer/run.py telemetry paths — flip it to True)
+        self.record_events = record_events
+        self._lock = threading.Lock()
+        self._events = []  # (name, t_start_s, dur_s, thread_ident, args)
+        self._dropped = 0
+        self._stats = stats
+        self._t0 = time.perf_counter()
+
+    def _recording(self):
+        if self.record_events is None:
+            return bool(os.environ.get("PADDLE_TPU_TELEMETRY"))
+        return self.record_events
+
+    @contextmanager
+    def span(self, name, sync=None, args=None):
+        """Time a scope. ``sync`` is an optional array/pytree blocked on
+        before the span closes; ``args`` is a small JSON-able dict shown
+        in the trace viewer."""
+        scope = _Scope(name)
+        start = time.perf_counter()
+        try:
+            yield scope
+        finally:
+            if sync is not None:
+                try:
+                    import jax
+
+                    jax.block_until_ready(sync)
+                except Exception:
+                    pass
+            end = time.perf_counter()
+            # a disabled tracer still stamps dur (callers like the trainer
+            # and harness consume scope.dur arithmetically) — it only stops
+            # recording events and feeding stats
+            scope.dur = end - start
+            if self.enabled:
+                if self._stats is not None:
+                    self._stats.get(name).add(scope.dur)
+                if self._recording():
+                    with self._lock:
+                        if len(self._events) < self.MAX_EVENTS:
+                            self._events.append(
+                                (name, start - self._t0, scope.dur,
+                                 threading.get_ident(), args))
+                        else:
+                            self._dropped += 1
+
+    def instant(self, name, args=None):
+        """Record a zero-duration marker (rendered as a thin slice)."""
+        with self.span(name, args=args):
+            pass
+
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def reset(self):
+        """Drop recorded spans and restart the trace clock (the StatSet
+        aggregates are owned by the StatSet and are NOT reset here)."""
+        with self._lock:
+            self._events = []
+            self._dropped = 0
+            self._t0 = time.perf_counter()
+
+    def to_chrome_trace(self):
+        """Chrome trace-event dict: ``{"traceEvents": [...]}`` with "X"
+        complete events (ts/dur in µs) plus process/thread metadata."""
+        pid = os.getpid()
+        with self._lock:
+            snapshot = list(self._events)
+            dropped = self._dropped
+        out = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                "args": {"name": self.name}}]
+        tids = {}
+        for name, ts, dur, ident, args in snapshot:
+            tid = tids.setdefault(ident, len(tids))
+            ev = {"ph": "X", "name": name, "pid": pid, "tid": tid,
+                  "ts": round(ts * 1e6, 3), "dur": round(dur * 1e6, 3)}
+            if args:
+                ev["args"] = dict(args)
+            out.append(ev)
+        for ident, tid in tids.items():
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": tid,
+                        "args": {"name": "host thread %d" % tid}})
+        trace = {"traceEvents": out, "displayTimeUnit": "ms"}
+        if dropped:
+            trace["metadata"] = {"dropped_spans": dropped}
+        return trace
+
+    def export(self, path):
+        """Write the Chrome-trace JSON (gzipped when ``path`` ends in
+        .gz); returns ``path``. Open the file in Perfetto or
+        chrome://tracing."""
+        data = self.to_chrome_trace()
+        if path.endswith(".gz"):
+            import gzip
+
+            with gzip.open(path, "wt") as fh:
+                json.dump(data, fh)
+        else:
+            with open(path, "w") as fh:
+                json.dump(data, fh)
+        return path
+
+
+_global_tracer = SpanTracer(record_events=None)
+
+
+def get_tracer():
+    """The process-global tracer every subsystem shares."""
+    return _global_tracer
+
+
+def span(name, sync=None, args=None):
+    """Module-level shortcut: ``with observe.span("feed"): ...``."""
+    return _global_tracer.span(name, sync=sync, args=args)
+
+
+def export(path):
+    return _global_tracer.export(path)

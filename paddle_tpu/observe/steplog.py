@@ -1,0 +1,302 @@
+"""Per-step JSONL telemetry sink.
+
+``PADDLE_TPU_TELEMETRY=<dir>`` makes the trainer write one JSON record
+per training step to ``<dir>/<run>.steps.jsonl`` plus a Chrome-trace
+export of the host spans to ``<dir>/<run>.trace.json`` (open in
+Perfetto). A repeated run of the same name in the same directory gets a
+``-N`` filename suffix instead of clobbering the earlier telemetry.
+The schema is stable and documented (docs/observability.md) and guarded
+by a golden-file test (tests/golden/steplog_schema.json).
+
+Record types (field ``type``):
+
+* ``meta``  — first line: ``schema`` version, ``run`` name, jax/backend
+  info, caller metadata.
+* ``step``  — one per finalized training step: ``step`` (global step
+  number), ``pass``/``batch``, ``wall_ms`` (interval between successive
+  step finalizations — steady-state per-step wall time; the first record
+  of a run includes compile), ``feed_ms`` (host data conversion),
+  ``cost``, ``examples``, ``examples_per_sec``, optional ``device_ms``
+  (when a device trace was taken), optional ``tflops``/``mfu_pct`` (when
+  step FLOPs were registered), optional ``metrics`` (evaluator results),
+  ``t`` (seconds since the meta record).
+* ``pass``  — end of a pass: ``pass``, ``metrics``.
+* ``event`` — a ``jax.monitoring`` duration event (compile times etc.):
+  ``event``, ``secs``.
+* ``bench_row`` — a benchmark record mirrored by benchmark/run.py, so
+  BENCH rows and telemetry can never disagree.
+* ``end``   — last line: total ``steps`` written.
+
+Unknown analysis code must ignore record types it does not know; within
+a record type, fields are only ever added, never renamed (bump
+``SCHEMA_VERSION`` if that ever has to break).
+"""
+
+import json
+import os
+import threading
+import time
+import weakref
+
+SCHEMA_VERSION = 1
+
+# StepLogs currently subscribed to jax.monitoring events. Weak so a log
+# that was never closed (crashed run) doesn't stay pinned by the listener.
+_open_logs = weakref.WeakSet()
+_listener_registered = False
+
+
+def _ensure_monitoring_listener():
+    """Register the ONE process-wide jax.monitoring duration listener
+    (registration is append-only in jax — there is no unregister)."""
+    global _listener_registered
+    if _listener_registered:
+        return
+    try:
+        from jax import monitoring
+    except Exception:
+        return
+
+    def _listener(event, secs, **kw):
+        for log in list(_open_logs):
+            log._on_monitoring_event(event, secs)
+
+    try:
+        monitoring.register_event_duration_secs_listener(_listener)
+        _listener_registered = True
+    except Exception:
+        pass
+
+
+def telemetry_dir():
+    """The active telemetry directory or None: the live environment
+    variable ``PADDLE_TPU_TELEMETRY`` wins (so it can be set after
+    import), falling back to the ``telemetry`` flag."""
+    env = os.environ.get("PADDLE_TPU_TELEMETRY")
+    if env:
+        return env
+    try:
+        from paddle_tpu.utils import flags
+
+        return flags.get_flag("telemetry") or None
+    except Exception:
+        return None
+
+
+def stats_enabled():
+    """True when the per-pass StatSet dump is requested
+    (``PADDLE_TPU_STATS=1``, live env first, then the ``stats`` flag)."""
+    env = os.environ.get("PADDLE_TPU_STATS")
+    if env is not None:
+        return env.lower() in ("1", "true", "yes", "on")
+    try:
+        from paddle_tpu.utils import flags
+
+        return bool(flags.get_flag("stats"))
+    except Exception:
+        return False
+
+
+def from_env(run_name="train", meta=None):
+    """A StepLog when telemetry is enabled, else None (the no-op path)."""
+    directory = telemetry_dir()
+    if not directory:
+        return None
+    try:
+        return StepLog(directory, run_name=run_name, meta=meta)
+    except OSError as exc:
+        from paddle_tpu.utils.logger import logger
+
+        logger.warning("telemetry disabled: cannot open %s (%s)",
+                       directory, exc)
+        return None
+
+
+class StepLog:
+    """JSONL writer of per-step records. Thread-safe; every record is
+    flushed so a crashed run keeps its telemetry."""
+
+    def __init__(self, directory, run_name="train", meta=None,
+                 compile_events=True):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        # never clobber an earlier run in the same telemetry dir: a second
+        # run of the same name gets a -N suffix (train-2.steps.jsonl, with
+        # its span export at train-2.trace.json). Mode "x" makes the pick
+        # atomic, so concurrent processes sharing the dir (multi-host)
+        # land on distinct files instead of truncating each other.
+        base = os.path.join(directory, run_name)
+        n = 0
+        while True:
+            n += 1
+            self.path = (base + ".steps.jsonl" if n == 1
+                         else "%s-%d.steps.jsonl" % (base, n))
+            try:
+                self._fh = open(self.path, "x")
+                break
+            except FileExistsError:
+                continue
+        self.trace_path = self.path[:-len(".steps.jsonl")] + ".trace.json"
+        self._lock = threading.Lock()
+        self._flops = None
+        self._steps = 0
+        self._closed = False
+        self._t0 = time.perf_counter()
+        header = {"type": "meta", "schema": SCHEMA_VERSION, "run": run_name,
+                  "unix_time": round(time.time(), 3)}
+        try:
+            import jax
+
+            header["jax_version"] = jax.__version__
+            header["backend"] = jax.default_backend()
+            header["device_count"] = jax.device_count()
+        except Exception:
+            pass
+        if meta:
+            header.update(meta)
+        self.write(header)
+        if compile_events:
+            self._subscribe_compile_events()
+
+    def _subscribe_compile_events(self):
+        """Mirror jax.monitoring duration events (compile times and
+        friends) into the log. Listener registration is append-only in
+        jax, so ONE module-level listener fans out to the currently-open
+        logs (weakly held, dropped on close) — constructing many StepLogs
+        in one process must not accumulate dead listeners."""
+        _ensure_monitoring_listener()
+        _open_logs.add(self)
+
+    def _on_monitoring_event(self, event, secs):
+        if self._closed:
+            return
+        try:
+            self.write({"type": "event", "event": str(event),
+                        "secs": round(float(secs), 6)})
+        except Exception:
+            pass
+
+    def register_flops(self, flops_per_step):
+        """Static FLOPs of one step; enables tflops/mfu_pct on step
+        records."""
+        self._flops = flops_per_step
+
+    def write(self, record):
+        """Append one raw record (a JSON-able dict with a ``type``)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+
+    def log_step(self, step, wall_ms=None, cost=None, examples=None,
+                 pass_id=None, batch_id=None, feed_ms=None, device_ms=None,
+                 metrics=None):
+        rec = {"type": "step", "step": int(step),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if pass_id is not None:
+            rec["pass"] = int(pass_id)
+        if batch_id is not None:
+            rec["batch"] = int(batch_id)
+        if wall_ms is not None:
+            rec["wall_ms"] = round(float(wall_ms), 4)
+        if feed_ms is not None:
+            rec["feed_ms"] = round(float(feed_ms), 4)
+        if cost is not None:
+            rec["cost"] = round(float(cost), 6)
+        if device_ms is not None:
+            rec["device_ms"] = round(float(device_ms), 4)
+        if examples is not None:
+            rec["examples"] = int(examples)
+            if wall_ms:
+                rec["examples_per_sec"] = round(
+                    examples / wall_ms * 1000.0, 2)
+        lead_ms = device_ms if device_ms else wall_ms
+        if self._flops and lead_ms:
+            from paddle_tpu.observe.attribution import achieved
+
+            tflops, mfu = achieved(self._flops, lead_ms)
+            if tflops is not None:
+                rec["tflops"] = round(tflops, 2)
+                rec["mfu_pct"] = round(mfu, 2)
+        if metrics:
+            rec["metrics"] = {k: float(v) for k, v in metrics.items()
+                              if isinstance(v, (int, float))}
+        self.write(rec)
+        self._steps += 1
+
+    def log_pass(self, pass_id, metrics=None):
+        rec = {"type": "pass", "pass": int(pass_id),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if metrics:
+            rec["metrics"] = {k: float(v) for k, v in metrics.items()
+                              if isinstance(v, (int, float))}
+        self.write(rec)
+
+    def close(self):
+        _open_logs.discard(self)
+        with self._lock:
+            if self._closed:
+                return
+            self._fh.write(json.dumps({"type": "end",
+                                       "steps": self._steps}) + "\n")
+            self._closed = True
+            self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_jsonl(path):
+    """Parse a steplog JSONL file into a list of record dicts."""
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def summarize_dir(directory):
+    """Summary dict over every ``*.steps.jsonl`` in a telemetry directory
+    (the ``paddle_tpu.cli observe`` command)."""
+    import glob
+
+    runs = []
+    for path in sorted(glob.glob(os.path.join(directory, "*.steps.jsonl"))):
+        records = read_jsonl(path)
+        steps = [r for r in records if r.get("type") == "step"]
+        meta = next((r for r in records if r.get("type") == "meta"), {})
+        events = [r for r in records if r.get("type") == "event"]
+        walls = [r["wall_ms"] for r in steps if "wall_ms" in r]
+        run = {"file": os.path.basename(path),
+               "run": meta.get("run"), "schema": meta.get("schema"),
+               "backend": meta.get("backend"), "steps": len(steps),
+               "compile_events": len(events),
+               "event_secs_total": round(sum(r.get("secs", 0.0)
+                                             for r in events), 3)}
+        if walls:
+            run["wall_ms_mean"] = round(sum(walls) / len(walls), 3)
+            run["wall_ms_min"] = round(min(walls), 3)
+            # steady state excludes the first record (includes compile)
+            tail = walls[1:] or walls
+            run["wall_ms_steady_mean"] = round(sum(tail) / len(tail), 3)
+        ex = [r["examples_per_sec"] for r in steps
+              if "examples_per_sec" in r]
+        if ex:
+            run["examples_per_sec_best"] = round(max(ex), 2)
+        costs = [r["cost"] for r in steps if "cost" in r]
+        if costs:
+            run["cost_first"] = costs[0]
+            run["cost_last"] = costs[-1]
+        runs.append(run)
+    traces = sorted(
+        os.path.basename(p)
+        for pat in ("*.json", "*.json.gz")
+        for p in glob.glob(os.path.join(directory, pat))
+        if not p.endswith(".steps.jsonl"))
+    return {"directory": directory, "runs": runs, "trace_files": traces}

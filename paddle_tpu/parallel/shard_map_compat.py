@@ -1,0 +1,28 @@
+"""shard_map across jax versions.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the ``jax``
+namespace, and its replication-check kwarg was renamed ``check_rep`` →
+``check_vma`` in the move. The parallel modules are written against the
+current spelling; this wrapper translates for older installs so the same
+call sites run on both.
+"""
+
+import inspect
+
+try:
+    from jax import shard_map as _impl
+except ImportError:  # older jax: experimental namespace, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _impl
+
+try:
+    _HAS_VMA = "check_vma" in inspect.signature(_impl).parameters
+except (TypeError, ValueError):
+    _HAS_VMA = True
+
+
+def shard_map(f, **kwargs):
+    if not _HAS_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif _HAS_VMA and "check_rep" in kwargs:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _impl(f, **kwargs)

@@ -20,7 +20,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from paddle_tpu.parallel.shard_map_compat import shard_map
 
 from paddle_tpu.utils.error import enforce
 

@@ -16,10 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from paddle_tpu.parallel.shard_map_compat import shard_map
 
 from paddle_tpu.utils.error import enforce
 

@@ -15,6 +15,9 @@ batch-sharded inputs and replicated (or ZeRO-sharded) parameters, replacing
 MultiGradientMachine and the pserver path (SURVEY.md §2.4).
 """
 
+import os
+import time
+
 import numpy as np
 
 import jax
@@ -29,6 +32,8 @@ from paddle_tpu.utils.error import enforce
 from paddle_tpu.utils.logger import logger
 
 
+from paddle_tpu.observe import spans as observe_spans
+from paddle_tpu.observe import steplog as observe_steplog
 from paddle_tpu.utils.stat import global_stats
 
 
@@ -194,6 +199,41 @@ class SGD:
         log_period = flags.get_flag("log_period")
         test_period = flags.get_flag("test_period")
 
+        # observability: host spans around every phase (feed / device step
+        # / evaluator read-back — they feed the global StatSet, dumped per
+        # pass under PADDLE_TPU_STATS=1, reference: the per-pass
+        # globalStat.printAllStatus dump) and, under
+        # PADDLE_TPU_TELEMETRY=<dir>, a JSONL step log + Chrome-trace
+        # export of the spans (docs/observability.md).
+        tracer = observe_spans.get_tracer()
+        slog = observe_steplog.from_env(
+            meta={"phase": "train", "num_passes": int(num_passes)})
+        prev_recording = tracer.record_events
+        if slog is not None:
+            # telemetry may be flag-configured (no env var), so force
+            # event recording on — this run WILL export a trace (restored
+            # after, so later non-telemetry runs don't keep buffering)
+            tracer.record_events = True
+            tracer.reset()  # the exported trace covers exactly this run
+        # first step's wall interval is anchored at train start, so the
+        # first record honestly includes compile time (the compile shows
+        # up as an ``event`` record too when jax.monitoring emits it)
+        last_final = {"t": time.perf_counter()}
+        try:
+            self._train_passes(reader, num_passes, event_handler, feeding,
+                               sync_params, test_reader, log_period,
+                               test_period, slog, last_final)
+        finally:
+            if slog is not None:
+                try:
+                    tracer.export(slog.trace_path)
+                finally:
+                    tracer.record_events = prev_recording
+                    slog.close()
+
+    def _train_passes(self, reader, num_passes, event_handler, feeding,
+                      sync_params, test_reader, log_period, test_period,
+                      slog, last_final):
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             eval_acc = {e.name: None for e in self.evaluators}
@@ -206,15 +246,31 @@ class SGD:
             # device_get before launching the next step. Events still fire
             # in order with exact values, one dispatch behind; handlers
             # reading live parameters mid-pass see the in-flight step.
-            pending = None  # (batch_id, loss, stats, feed)
+            pending = None  # (batch_id, loss, stats, feed, feed_ms, n_ex)
 
             def finalize(item):
-                b_id, loss, stats, feed = item
+                b_id, loss, stats, feed, feed_ms, n_examples = item
                 metrics = {}
-                for e in self.evaluators:
-                    eval_acc[e.name] = e.merge(
-                        eval_acc[e.name], jax.device_get(stats[e.name]))
-                    metrics[e.name] = e.result(eval_acc[e.name])
+                with observe_spans.span("eval_readback"):
+                    for e in self.evaluators:
+                        eval_acc[e.name] = e.merge(
+                            eval_acc[e.name], jax.device_get(stats[e.name]))
+                        metrics[e.name] = e.result(eval_acc[e.name])
+                    loss = float(loss)
+                if slog is not None:
+                    now = time.perf_counter()
+                    wall_ms = (now - last_final["t"]) * 1000.0
+                    last_final["t"] = now
+                    slog.log_step(
+                        step=self._pending_step_of(b_id), pass_id=pass_id,
+                        batch_id=b_id, wall_ms=wall_ms, feed_ms=feed_ms,
+                        cost=loss, examples=n_examples, metrics=metrics)
+                # reference per-batch sequence: forwardBackward done →
+                # EndForwardBackward → stats/periodic-test → EndIteration
+                # (TrainerInternal.cpp:66-140). With the one-deep pipeline
+                # both fire at finalize time, one dispatch behind.
+                event_handler(v2_event.EndForwardBackward(
+                    pass_id, b_id, gm=self))
                 if log_period and b_id % log_period == 0:
                     logger.info("pass %d batch %d cost=%.6f %s", pass_id,
                                 b_id, float(loss), _fmt_metrics(metrics))
@@ -230,16 +286,19 @@ class SGD:
                     logger.info("periodic test: cost=%.6f %s", result.cost,
                                 _fmt_metrics(result.metrics))
                     event_handler(result)
+                    # the eval pass must not be charged to the next step's
+                    # wall_ms interval
+                    last_final["t"] = time.perf_counter()
                 event_handler(v2_event.EndIteration(
                     pass_id, b_id, float(loss), metrics))
 
             self._pass_step_base = self._step_count
             for data_batch in reader():
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                with global_stats.timer("feed"):
+                with observe_spans.span("feed") as feed_scope:
                     feed = convert_feed(self.topology, data_batch, feeding)
                 self._rng, step_rng = jax.random.split(self._rng)
-                with global_stats.timer("train_step"):
+                with observe_spans.span("train_step"):
                     (loss, self._trainable, self._replica, self._state,
                      self._opt_state, stats) = self._train_step(
                         self._trainable, self._replica, self._static,
@@ -247,7 +306,8 @@ class SGD:
                 self._step_count += 1
                 if pending is not None:
                     finalize(pending)
-                pending = (batch_id, loss, stats, feed)
+                pending = (batch_id, loss, stats, feed,
+                           feed_scope.dur * 1000.0, len(data_batch))
                 batch_id += 1
             if pending is not None:
                 finalize(pending)
@@ -258,12 +318,24 @@ class SGD:
                 logger.info("pass %d test: cost=%.6f %s", pass_id,
                             result.cost, _fmt_metrics(result.metrics))
                 event_handler(result)
+                # next pass's first step must not absorb this eval pass
+                last_final["t"] = time.perf_counter()
             if sync_params:
                 self._sync_back()
-            event_handler(v2_event.EndPass(
-                pass_id,
-                {e.name: e.result(eval_acc[e.name]) for e in self.evaluators},
-                gm=self))
+            pass_metrics = {e.name: e.result(eval_acc[e.name])
+                            for e in self.evaluators}
+            if slog is not None:
+                slog.log_pass(pass_id, metrics=pass_metrics)
+            if observe_steplog.stats_enabled():
+                # reference per-pass timer dump: globalStat.printAllStatus
+                # + reset at FinishTrainPass (paddle/trainer/Trainer.cpp)
+                global_stats.print_all()
+                global_stats.reset()
+            event_handler(v2_event.EndPass(pass_id, pass_metrics, gm=self))
+            # pass-boundary work (_sync_back, stats dump, EndPass handlers
+            # — e.g. a checkpoint save) must not be charged to the next
+            # pass's first step wall_ms
+            last_final["t"] = time.perf_counter()
         if sync_params:
             self._sync_back()
 
@@ -278,14 +350,17 @@ class SGD:
         eval_acc = {e.name: None for e in self.evaluators}
         total_cost, n_batches = 0.0, 0
         for data_batch in reader():
-            feed = convert_feed(self.topology, data_batch, feeding)
-            cost, stats, _ = self._eval_step(
-                self._trainable, self._static, self._state, feed)
-            total_cost += float(cost)
-            n_batches += 1
-            for e in self.evaluators:
-                eval_acc[e.name] = e.merge(eval_acc[e.name],
-                                           jax.device_get(stats[e.name]))
+            with observe_spans.span("test_feed"):
+                feed = convert_feed(self.topology, data_batch, feeding)
+            with observe_spans.span("test_step"):
+                cost, stats, _ = self._eval_step(
+                    self._trainable, self._static, self._state, feed)
+            with observe_spans.span("eval_readback"):
+                total_cost += float(cost)
+                n_batches += 1
+                for e in self.evaluators:
+                    eval_acc[e.name] = e.merge(eval_acc[e.name],
+                                               jax.device_get(stats[e.name]))
         metrics = {e.name: e.result(eval_acc[e.name]) for e in self.evaluators}
         return v2_event.TestResult(
             pass_id, total_cost / max(n_batches, 1), metrics)

@@ -91,6 +91,12 @@ define_flag("coordinator_endpoint", "", "host:port of the elastic coordinator se
 define_flag("num_shards_per_task", 8, "dataset chunks per coordinator task")
 define_flag("task_timeout_sec", 600.0, "coordinator task timeout (cf. go/master timeoutDur)")
 define_flag("task_failure_max", 3, "drop a task after N failures (cf. go/master failureMax)")
+define_flag("telemetry", "",
+            "directory for per-step JSONL telemetry + Chrome-trace span "
+            "export (env PADDLE_TPU_TELEMETRY; docs/observability.md)")
+define_flag("stats", False,
+            "print + reset the global StatSet at every EndPass (env "
+            "PADDLE_TPU_STATS; cf. globalStat.printAllStatus per pass)")
 define_flag("trap_fpe", False,
             "fail fast on NaN/Inf in jitted programs (cf. feenableexcept "
             "FPE trapping, TrainerMain.cpp:49) via jax_debug_nans")

@@ -304,3 +304,89 @@ def test_test_reader_runs_per_pass_by_default():
         if isinstance(e, paddle.event.TestResult) else None,
         test_reader=minibatch.batch(_toy_reader(dim=4, classes=2, n=8), 4))
     assert [r.pass_id for r in results] == [0, 1, 2]
+
+
+def test_event_stream_ordering():
+    """Reference per-batch sequence (TrainerInternal.cpp:66-140):
+    BeginPass → BeginIteration(b) → EndForwardBackward(b) →
+    EndIteration(b) → … → EndPass, with the one-deep pipeline allowed to
+    fire BeginIteration(b+1) before batch b finalizes."""
+    x, lab, out, cost = _toy_classification_net(dim=4, classes=2)
+    params = Parameters.create(cost)
+    err = evaluator.classification_error(input=out, label=lab)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Momentum(learning_rate=0.1),
+                                 extra_layers=[err])
+    events = []
+    trainer.train(minibatch.batch(_toy_reader(dim=4, classes=2, n=12), 4),
+                  num_passes=2, event_handler=events.append)
+
+    def idx(cls, pass_id, batch_id=None):
+        for i, e in enumerate(events):
+            if (isinstance(e, cls) and e.pass_id == pass_id
+                    and (batch_id is None or e.batch_id == batch_id)):
+                return i
+        raise AssertionError("missing %s p%s b%s" % (cls, pass_id, batch_id))
+
+    for p in range(2):
+        begin, end = idx(paddle.event.BeginPass, p), idx(paddle.event.EndPass, p)
+        assert begin < end
+        for b in range(3):
+            bi = idx(paddle.event.BeginIteration, p, b)
+            fb = idx(paddle.event.EndForwardBackward, p, b)
+            ei = idx(paddle.event.EndIteration, p, b)
+            assert begin < bi < fb < ei < end
+    # every batch's full triple fired: 2 passes x 3 batches
+    assert sum(isinstance(e, paddle.event.EndIteration) for e in events) == 6
+    assert sum(isinstance(e, paddle.event.EndForwardBackward)
+               for e in events) == 6
+    # EndIteration carries the exact cost + evaluator metrics dict
+    for e in events:
+        if isinstance(e, paddle.event.EndIteration):
+            assert isinstance(e.cost, float)
+            assert isinstance(e.metrics, dict) and err.name in e.metrics
+        if isinstance(e, paddle.event.EndPass):
+            assert err.name in e.metrics
+            assert 0.0 <= e.metrics[err.name] <= 1.0
+
+
+def test_test_result_metrics_dict():
+    x, lab, out, cost = _toy_classification_net(dim=4, classes=2)
+    params = Parameters.create(cost)
+    err = evaluator.classification_error(input=out, label=lab)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Momentum(learning_rate=0.1),
+                                 extra_layers=[err])
+    result = trainer.test(minibatch.batch(_toy_reader(dim=4, classes=2, n=8),
+                                          4))
+    assert isinstance(result, paddle.event.TestResult)
+    assert isinstance(result.cost, float)
+    assert isinstance(result.metrics, dict)
+    assert set(result.metrics) == {err.name}
+    assert 0.0 <= result.metrics[err.name] <= 1.0
+
+
+def test_stats_dump_at_end_pass(monkeypatch):
+    """PADDLE_TPU_STATS=1 → the global StatSet is printed AND reset at
+    every EndPass (reference: globalStat.printAllStatus + reset at
+    FinishTrainPass, paddle/trainer/Trainer.cpp)."""
+    from paddle_tpu.utils.stat import global_stats
+
+    monkeypatch.setenv("PADDLE_TPU_STATS", "1")
+    global_stats.reset()  # drop accumulation from earlier tests
+    dumps = []
+    monkeypatch.setattr(
+        global_stats, "print_all",
+        lambda *a, **kw: dumps.append(dict(global_stats.as_dict())))
+    x, lab, out, cost = _toy_classification_net(dim=4, classes=2)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Momentum(learning_rate=0.1))
+    trainer.train(minibatch.batch(_toy_reader(dim=4, classes=2, n=8), 4),
+                  num_passes=2, event_handler=lambda e: None)
+    assert len(dumps) == 2
+    for snap in dumps:  # the trainer phases all fed the StatSet...
+        assert {"feed", "train_step", "eval_readback"} <= set(snap)
+        assert snap["feed"]["count"] == 2
+    # ...and the per-pass reset emptied it after each dump
+    assert "feed" not in global_stats.as_dict()
