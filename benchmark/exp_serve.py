@@ -1,0 +1,146 @@
+"""Serving throughput/latency experiment over the paddle_tpu.serve
+engine (docs/serving.md).
+
+Exports the dense-MNIST MLP demo bundle into a scratch directory (or
+takes ``--bundle`` for a pre-exported one), fronts it with the
+dynamic-batching engine, and drives it with N concurrent closed-loop
+submitters for a fixed request count. Emits ONE audited JSON row:
+
+    {"metric": "serve_mlp_qps_c8", "value": <qps>, "unit": "qps",
+     "p50_ms": ..., "p99_ms": ..., "requests": ..., "batches": ...,
+     "max_batch": ..., "max_latency_ms": ..., "clients": ...}
+
+Every row passes ``benchmark.harness.sanitize_bench_row`` (serving
+invariants: a row with p99 < p50 or qps <= 0 is REJECTED — such a row
+can only come from broken measurement, tests/test_bench_rows.py) and is
+mirrored into the telemetry steplog as ``bench_row`` when
+PADDLE_TPU_TELEMETRY is set, the same contract as benchmark/run.py.
+The per-batch ``serve_batch`` records ride the engine's own steplog in
+the same telemetry dir, so the row and the batch trace can't disagree.
+
+Usage:
+  python benchmark/exp_serve.py                       # export + measure
+  python benchmark/exp_serve.py --clients 16 --requests 800
+  python benchmark/exp_serve.py --bundle /path/to/bundle
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _export_demo_bundle(out_dir, batch_sizes):
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.vision import mlp
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = mlp()
+    params = Parameters.create(out)
+    export_bundle(out, params, out_dir, batch_sizes=batch_sizes,
+                  name="mnist_mlp")
+    return out_dir
+
+
+def measure(bundle_dir, clients, requests, rows_per_request,
+            max_latency_ms):
+    from paddle_tpu.serve import InferenceEngine, load_bundle
+
+    bundle = load_bundle(bundle_dir)
+    engine = InferenceEngine(bundle, max_latency_ms=max_latency_ms)
+    rng = np.random.RandomState(0)
+    spec = bundle.inputs[0]
+    shape = (rows_per_request,) + tuple(
+        bundle.feed_shape(spec, rows_per_request)[1:])
+    payloads = [
+        {spec["name"]: rng.randn(*shape).astype(spec["dtype"])}
+        for _ in range(8)]
+    per_client = requests // clients
+    latencies, lat_lock = [], threading.Lock()
+
+    def client(cid):
+        mine = []
+        for i in range(per_client):
+            t0 = time.perf_counter()
+            engine.infer(payloads[(cid + i) % len(payloads)], timeout=120.0)
+            mine.append((time.perf_counter() - t0) * 1e3)
+        with lat_lock:
+            latencies.extend(mine)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_start
+    stats = engine.stats()
+    engine.stop()
+    lat = np.asarray(latencies)
+    return {
+        "metric": "serve_mlp_qps_c%d" % clients,
+        "value": round(len(lat) / wall_s, 2),
+        "unit": "qps",
+        "p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "requests": int(len(lat)),
+        "batches": int(stats.get("batches", 0)),
+        "rows_per_request": rows_per_request,
+        "clients": clients,
+        "max_batch": stats["max_batch_size"],
+        "max_latency_ms": stats["max_latency_ms"],
+        "wall_s": round(wall_s, 3),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bundle", default="",
+                    help="pre-exported bundle dir (default: export the "
+                         "dense-MNIST MLP demo bundle to a tmp dir)")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=400)
+    ap.add_argument("--rows-per-request", type=int, default=1)
+    ap.add_argument("--max-latency-ms", type=float, default=5.0)
+    ap.add_argument("--batch-sizes", default="1,8,32")
+    args = ap.parse_args(argv)
+
+    from benchmark.harness import enable_compile_cache, sanitize_bench_row
+
+    enable_compile_cache()
+    bundle_dir = args.bundle
+    if not bundle_dir:
+        bundle_dir = _export_demo_bundle(
+            tempfile.mkdtemp(prefix="serve_bundle_"),
+            tuple(int(b) for b in args.batch_sizes.split(",")))
+        print(json.dumps({"note": "exported demo bundle",
+                          "bundle": bundle_dir}))
+    row = measure(bundle_dir, args.clients, args.requests,
+                  args.rows_per_request, args.max_latency_ms)
+    row = sanitize_bench_row(row)  # raises on p99<p50 / qps<=0: never
+    # publish a serving row the invariants reject
+    print(json.dumps(row))
+
+    from paddle_tpu.observe import steplog as observe_steplog
+
+    slog = observe_steplog.from_env(run_name="exp_serve",
+                                    meta={"phase": "bench"})
+    if slog is not None:
+        slog.write(dict(row, type="bench_row"))
+        slog.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -155,8 +155,30 @@ def sanitize_bench_row(rec):
       becomes None — min-of-N under >100% spread is tunnel noise, not a
       repeatability statement.
 
+    Serving rows (benchmark/exp_serve.py: throughput ``qps`` +
+    ``p50_ms``/``p99_ms`` latency percentiles) get REJECTED, not
+    demoted, on violation: percentiles of one sample set are monotone in
+    the quantile and a throughput over a positive request count is
+    positive, so ``p99 < p50`` or ``qps <= 0`` can only mean the
+    measurement code is broken — there is no honest demoted form of such
+    a row (ValueError; contrast the wall-vs-device demotion above, where
+    the device number stays publishable).
+
     Mutates and returns ``rec``.
     """
+    p50, p99 = rec.get("p50_ms"), rec.get("p99_ms")
+    if p50 is not None and p99 is not None and p99 < p50:
+        raise ValueError(
+            "refusing serving row %r: p99_ms %.4f < p50_ms %.4f — "
+            "percentiles of one latency sample are monotone; the "
+            "measurement is broken" % (rec.get("metric"), p99, p50))
+    qps = rec.get("qps", rec.get("value") if rec.get("unit") == "qps"
+                  else None)
+    if qps is not None and qps <= 0:
+        raise ValueError(
+            "refusing serving row %r: qps %.4f <= 0 — throughput over a "
+            "positive request count cannot be non-positive"
+            % (rec.get("metric"), qps))
     notes = []
     wall, dev = rec.get("wall_ms"), rec.get("device_ms")
     if wall is not None and dev is not None and wall < dev:

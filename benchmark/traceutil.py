@@ -2,9 +2,19 @@
 metadata map, "X" duration events, the "XLA Modules"/"XLA Ops" track
 names) was promoted into :mod:`paddle_tpu.observe.attribution` as part of
 the first-class observability subsystem. Import from there; this module
-keeps old callers working."""
+keeps old callers working (and says so once per process via
+DeprecationWarning — tests/test_observe.py pins both the warning and
+the re-export equivalence)."""
 
-from paddle_tpu.observe.attribution import (  # noqa: F401
+import warnings
+
+warnings.warn(
+    "benchmark.traceutil is a compat shim; import DeviceTrace/capture/"
+    "device_busy_ms/parse_trace_dir/parse_trace_files from "
+    "paddle_tpu.observe.attribution instead",
+    DeprecationWarning, stacklevel=2)
+
+from paddle_tpu.observe.attribution import (  # noqa: F401,E402
     DeviceTrace,
     capture,
     device_busy_ms,

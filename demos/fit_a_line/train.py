@@ -1,0 +1,91 @@
+"""fit_a_line: linear regression on UCI housing, the classic first demo
+(reference: the fit_a_line tutorial config over v2 uci_housing), grown
+into the train → export → serve path (docs/serving.md):
+
+1. train a dense regressor on paddle_tpu.dataset.uci_housing (real
+   housing.data when cached, synthetic fallback otherwise),
+2. AOT-export the trained forward as a serve bundle
+   (trainer.export_inference_bundle — the dense-regression demo bundle),
+3. reload the bundle (pure deserialization, no graph rebuild) and check
+   it against live inference.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu as paddle
+from paddle_tpu import data_type as dt
+from paddle_tpu import layer as L, minibatch, optimizer as opt
+from paddle_tpu.dataset import uci_housing
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.reader import decorator as reader_ops
+
+
+def build():
+    x = L.data(name="x", type=dt.dense_vector(uci_housing.FEATURE_DIM))
+    y = L.data(name="y", type=dt.dense_vector(1))
+    pred = L.fc(input=x, size=1, act=None, name="fal_predict")
+    cost = L.square_error_cost(input=pred, label=y, name="fal_cost")
+    return pred, cost
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-passes", type=int, default=30)
+    ap.add_argument("--export", default="fit_a_line_bundle",
+                    help="bundle directory ('' skips the export step)")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny run for smoke tests")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        args.num_passes = 3
+        train_reader = reader_ops.firstn(uci_housing.train(), 128)
+        test_reader = reader_ops.firstn(uci_housing.test(), 64)
+    else:
+        train_reader = reader_ops.shuffle(uci_housing.train(),
+                                          buf_size=512)
+        test_reader = uci_housing.test()
+
+    pred, cost = build()
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost, params, opt.Momentum(learning_rate=1e-2, momentum=0.9))
+
+    costs = []
+    trainer.train(
+        minibatch.batch(train_reader, args.batch_size),
+        num_passes=args.num_passes,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None)
+    result = trainer.test(minibatch.batch(test_reader, args.batch_size))
+    print("train cost %.4f -> %.4f, test cost %.4f"
+          % (costs[0], costs[-1], result.cost))
+
+    samples = [(s[0],) for _, s in zip(range(4), test_reader())]
+    live = paddle.inference.infer(pred, params, samples, feeding={"x": 0})
+    print("predictions:", np.asarray(live).ravel().round(3).tolist())
+
+    if args.export:
+        manifest = trainer.export_inference_bundle(
+            pred, args.export, batch_sizes=(1, 4, 32), name="fit_a_line")
+        print("exported bundle to %s (buckets %s)"
+              % (args.export, [b["batch"] for b in manifest["buckets"]]))
+        from paddle_tpu.serve import load_bundle
+
+        bundle = load_bundle(args.export)
+        got = bundle.infer(
+            {"x": np.stack([s[0] for s in samples])})["fal_predict"]
+        np.testing.assert_allclose(got, np.asarray(live).reshape(-1, 1),
+                                   atol=1e-5)
+        print("bundle reload matches live inference (atol 1e-5)")
+
+
+if __name__ == "__main__":
+    main()

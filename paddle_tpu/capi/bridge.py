@@ -114,7 +114,69 @@ class _Model:
         return name
 
 
+class _BundleModel:
+    """A model backed by an AOT-exported serve bundle (docs/serving.md):
+    load is pure deserialization — no topology/layer graph is built, no
+    builder runs, no model-config proto is replayed. This is the
+    Python-free-inference path: the only work left in-process is numpy
+    marshalling + the jax.export call, both PJRT-C-API-shaped."""
+
+    def __init__(self, bundle_dir):
+        from paddle_tpu.serve import load_bundle
+
+        self.bundle = load_bundle(bundle_dir)
+        self.input_specs = {s["name"]: s for s in self.bundle.inputs}
+        names = list(self.input_specs)
+        self.default_input = names[0] if len(names) == 1 else None
+        self.output_name = self.bundle.outputs[0]["name"]
+
+    def resolve_input(self, input_name):
+        name = input_name or self.default_input
+        if name is None or name not in self.input_specs:
+            raise KeyError(
+                "unknown input %r (bundle inputs: %s)"
+                % (input_name, sorted(self.input_specs)))
+        return name
+
+    def forward_dense(self, name, rows):
+        spec = self.input_specs[name]
+        if spec["kind"] not in ("dense", "index"):
+            raise TypeError("input %r is %s, not dense"
+                            % (name, spec["kind"]))
+        return self.bundle.infer({name: rows})[self.output_name]
+
+    def forward_ids(self, name, seq_batch):
+        """SequenceBatch -> the bundle's fixed-T padded layout. Sequences
+        longer than the exported seq_len are rejected (re-export with a
+        larger --seq-len), shorter ones ride the lengths mask."""
+        spec = self.input_specs[name]
+        if spec["kind"] != "seq_index":
+            raise TypeError("input %r is %s, not an id sequence"
+                            % (name, spec["kind"]))
+        data = np.asarray(seq_batch.data)
+        lengths = np.asarray(seq_batch.lengths, np.int32)
+        T = self.bundle.seq_len
+        if data.shape[1] > T:
+            if lengths.max(initial=0) > T:
+                raise ValueError(
+                    "sequence of length %d exceeds the bundle's exported "
+                    "seq_len %d" % (int(lengths.max()), T))
+            data = data[:, :T]
+        elif data.shape[1] < T:
+            pad = np.zeros((data.shape[0], T - data.shape[1]), data.dtype)
+            data = np.concatenate([data, pad], axis=1)
+        return self.bundle.infer(
+            {name: data.astype(np.int32), name + ":lens": lengths}
+        )[self.output_name]
+
+
 def model_create(builder_spec, params_tar):
+    from paddle_tpu.serve.bundle import is_bundle
+
+    if is_bundle(params_tar):
+        # the bundle is self-contained; a builder spec would rebuild the
+        # very graph the bundle exists to avoid
+        return _BundleModel(params_tar)
     return _Model(builder_spec, params_tar)
 
 
@@ -128,10 +190,12 @@ def _pack(out):
 
 
 def model_forward_dense(model, input_name, data_bytes, height, width):
-    import jax.numpy as jnp
-
     name = model.resolve_input(input_name)
     arr = np.frombuffer(data_bytes, dtype=np.float32).reshape(height, width)
+    if isinstance(model, _BundleModel):
+        return _pack(model.forward_dense(name, arr))
+    import jax.numpy as jnp
+
     feed = {name: jnp.asarray(arr)}
     out = model.inference._forward(model.inference._params, feed)
     value = out[model.inference.outputs[0].name]
@@ -140,13 +204,15 @@ def model_forward_dense(model, input_name, data_bytes, height, width):
 
 
 def model_forward_ids(model, input_name, id_bytes, seq_starts):
-    import jax.numpy as jnp
-
     from paddle_tpu.core.sequence import SequenceBatch
 
     name = model.resolve_input(input_name)
     flat = np.frombuffer(id_bytes, dtype=np.int32)
     sb = SequenceBatch.from_flat(flat, np.asarray(seq_starts, np.int64))
+    if isinstance(model, _BundleModel):
+        return _pack(model.forward_ids(name, sb))
+    import jax.numpy as jnp
+
     feed = {name: sb}
     out = model.inference._forward(model.inference._params, feed)
     value = out[model.inference.outputs[0].name]
@@ -158,15 +224,20 @@ def model_forward_sparse_binary(model, input_name, col_bytes, row_offsets):
     """CSR sparse-binary rows -> dense one-hot bag-of-words feed (the
     sparse_binary_vector slot's device format; reference: capi sparse
     matrix input, paddle/capi/examples/model_inference/sparse_binary)."""
-    import jax.numpy as jnp
-
     name = model.resolve_input(input_name)
-    itype = model.input_types[name]
+    if isinstance(model, _BundleModel):
+        dim = model.input_specs[name]["dim"]
+    else:
+        dim = model.input_types[name].dim
     cols = np.frombuffer(col_bytes, dtype=np.uint32)
     offs = np.asarray(row_offsets, np.int64)
-    dense = np.zeros((len(offs) - 1, itype.dim), np.float32)
+    dense = np.zeros((len(offs) - 1, dim), np.float32)
     for i in range(len(offs) - 1):
         dense[i, cols[offs[i]: offs[i + 1]].astype(np.int64)] = 1.0
+    if isinstance(model, _BundleModel):
+        return _pack(model.forward_dense(name, dense))
+    import jax.numpy as jnp
+
     feed = {name: jnp.asarray(dense)}
     out = model.inference._forward(model.inference._params, feed)
     value = out[model.inference.outputs[0].name]

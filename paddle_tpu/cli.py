@@ -287,6 +287,88 @@ def cmd_merge_model(args):
     return 0
 
 
+def cmd_export(args):
+    """AOT-export an inference bundle (docs/serving.md): lower the
+    forward per batch bucket with jax.export and write manifest + packed
+    params + serialized artifacts. The bundle reloads in a fresh process
+    WITHOUT re-running any model-config code (contrast merge_model, which
+    still rebuilds the topology from its proto at load time)."""
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve.export import export_bundle, verify_bundle
+
+    reset_name_counters()
+    if args.builder:
+        from paddle_tpu.capi.bridge import _run_builder
+
+        outputs = _run_builder(args.builder)
+    elif args.config:
+        cfg = _load_config(args.config, getattr(args, "config_args", ""))
+        fn = getattr(cfg, "infer_outputs", None) or cfg.cost
+        outputs = fn()
+    else:
+        print("export needs --builder or --config", file=sys.stderr)
+        return 2
+    with open(args.params, "rb") as f:
+        params = Parameters.from_tar(f)
+    batch_sizes = tuple(int(b) for b in args.batch_sizes.split(",") if b)
+    manifest = export_bundle(outputs, params, args.output,
+                             batch_sizes=batch_sizes,
+                             seq_len=args.seq_len, name=args.name or None,
+                             platforms=(args.platforms.split(",")
+                                        if args.platforms else None))
+    import jax
+
+    if jax.default_backend() in manifest["platforms"]:
+        # export-time smoke: the written artifacts must deserialize and
+        # run HERE (cross-platform exports can only be checked on their
+        # target backend — `cli serve --selfcheck` there)
+        verify_bundle(args.output)
+    print(json.dumps({"bundle": args.output,
+                      "name": manifest["name"],
+                      "buckets": [b["batch"] for b in manifest["buckets"]],
+                      "inputs": [i["name"] for i in manifest["inputs"]],
+                      "platforms": manifest["platforms"]}))
+    return 0
+
+
+def cmd_serve(args):
+    """Serve an exported bundle behind the dynamic-batching engine.
+    ``--selfcheck`` loads the bundle, warms every bucket, pushes one
+    batch through the engine and exits — the deployment smoke gate
+    (tests/test_serve.py uses it the same way CI would)."""
+    from paddle_tpu.serve import InferenceEngine, load_bundle
+
+    bundle = load_bundle(args.bundle)
+    engine = InferenceEngine(bundle, max_batch_size=args.max_batch_size,
+                             max_latency_ms=args.max_latency_ms)
+    if args.selfcheck:
+        try:
+            out = engine.infer(bundle.dummy_inputs(rows=1), timeout=300.0)
+            print(json.dumps({
+                "ok": True, "bundle": bundle.name,
+                "buckets": bundle.batch_sizes(),
+                "outputs": {k: list(v.shape) for k, v in out.items()},
+                "stats": {k: v for k, v in engine.stats().items()
+                          if isinstance(v, int)}}))
+            return 0
+        finally:
+            engine.stop()
+    from paddle_tpu.serve.server import make_server
+
+    server = make_server(bundle, engine, host=args.host, port=args.port)
+    print("serving %r on http://%s:%d (POST /infer, GET /healthz)"
+          % (bundle.name, *server.server_address))
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        engine.stop()
+    return 0
+
+
 def cmd_observe(args):
     """Summarize a PADDLE_TPU_TELEMETRY directory: per-run step counts,
     steady-state wall times, compile-event totals, and the trace files
@@ -377,6 +459,33 @@ def main(argv=None):
     p.add_argument("--params", required=True)
     p.add_argument("-o", "--output", required=True)
     p.set_defaults(fn=cmd_merge_model)
+
+    p = sub.add_parser("export")
+    p.add_argument("--config", default="")
+    p.add_argument("--builder", default="")
+    p.add_argument("--config-args", default="")
+    p.add_argument("--params", required=True,
+                   help="parameter tar (trainer save_parameter_to_tar)")
+    p.add_argument("-o", "--output", required=True,
+                   help="bundle directory to write")
+    p.add_argument("--batch-sizes", default="1,8,32",
+                   help="comma-separated exported batch buckets")
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="padded time dim for sequence inputs")
+    p.add_argument("--name", default="")
+    p.add_argument("--platforms", default="",
+                   help="comma-separated lowering platforms (e.g. cpu,tpu)")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("serve")
+    p.add_argument("bundle", help="exported bundle directory")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="load, warm, run one batch, exit (smoke gate)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8866)
+    p.add_argument("--max-batch-size", type=int, default=None)
+    p.add_argument("--max-latency-ms", type=float, default=5.0)
+    p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)
     if getattr(args, "use_tpu", None) is not None \

@@ -25,6 +25,14 @@ Record types (field ``type``):
   ``event``, ``secs``.
 * ``bench_row`` — a benchmark record mirrored by benchmark/run.py, so
   BENCH rows and telemetry can never disagree.
+* ``serve_request`` — one completed inference request through the
+  serving engine (paddle_tpu.serve): ``rows``, ``queue_ms`` (time spent
+  waiting for a batch flush), ``latency_ms`` (enqueue -> result),
+  optional ``id``.
+* ``serve_batch`` — one batch the serving engine flushed to the device:
+  ``rows`` (real rows), ``bucket`` (padded batch size), ``infer_ms``,
+  optional ``batch``/``pad_rows``/``requests``/``queue_ms_max`` and the
+  ``flush`` reason (``size``/``deadline``/``drain``).
 * ``end``   — last line: total ``steps`` written.
 
 Unknown analysis code must ignore record types it does not know; within
@@ -224,6 +232,38 @@ class StepLog:
                               if isinstance(v, (int, float))}
         self.write(rec)
         self._steps += 1
+
+    def log_serve_request(self, rows, queue_ms, latency_ms=None,
+                          req_id=None):
+        """One completed serving request (paddle_tpu.serve engine)."""
+        rec = {"type": "serve_request", "rows": int(rows),
+               "queue_ms": round(float(queue_ms), 4),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if latency_ms is not None:
+            rec["latency_ms"] = round(float(latency_ms), 4)
+        if req_id is not None:
+            rec["id"] = int(req_id)
+        self.write(rec)
+
+    def log_serve_batch(self, rows, bucket, infer_ms, batch_id=None,
+                        pad_rows=None, requests=None, queue_ms_max=None,
+                        flush=None):
+        """One batch the serving engine flushed to the device."""
+        rec = {"type": "serve_batch", "rows": int(rows),
+               "bucket": int(bucket),
+               "infer_ms": round(float(infer_ms), 4),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if batch_id is not None:
+            rec["batch"] = int(batch_id)
+        if pad_rows is not None:
+            rec["pad_rows"] = int(pad_rows)
+        if requests is not None:
+            rec["requests"] = int(requests)
+        if queue_ms_max is not None:
+            rec["queue_ms_max"] = round(float(queue_ms_max), 4)
+        if flush is not None:
+            rec["flush"] = str(flush)
+        self.write(rec)
 
     def log_pass(self, pass_id, metrics=None):
         rec = {"type": "pass", "pass": int(pass_id),

@@ -170,6 +170,15 @@ class Parameters:
         tar.close()
         return params
 
+    def to_npz(self, f):
+        """Packed flat export of the raw values (the serve bundle's
+        parameter payload, paddle_tpu/serve/export.py): one .npz the
+        load side reads with nothing but numpy — no spec metadata, no
+        graph types. Use :meth:`to_tar` for checkpoints that must
+        restore is_state/is_static partitioning."""
+        np.savez(f, **{name: np.asarray(self._values[name])
+                       for name in self.names()})
+
     def init_from_tar(self, f):
         """Overwrite matching parameters from a tar (v2 init_from_tar)."""
         other = Parameters.from_tar(f)

@@ -1,0 +1,38 @@
+"""paddle_tpu.serve — AOT model bundles + dynamic-batching inference.
+
+Three pillars (docs/serving.md):
+
+* :func:`export_bundle` (serve/export.py) — AOT-lower the inference
+  forward per batch bucket and write a versioned bundle directory
+  (manifest + packed params + serialized StableHLO artifacts).
+* :func:`load_bundle` / :class:`Bundle` (serve/bundle.py) — reload and
+  run a bundle by deserialization alone: no model-config/layer-graph
+  code executes at load time.
+* :class:`InferenceEngine` (serve/engine.py) — thread-safe dynamic
+  batching (flush on size / flush on deadline, bucket padding, warm
+  per-bucket executable cache) with observe spans + steplog records.
+
+``paddle_tpu.cli export`` / ``cli serve`` wrap the three from the
+command line; ``paddle_tpu/capi`` loads bundles through the same
+:func:`load_bundle` for the Python-free-inference path.
+
+The import split is deliberate: this module and everything reachable
+from :func:`load_bundle` stay free of the graph machinery —
+``export_bundle`` (which does build the graph) is lazy-loaded.
+"""
+
+from paddle_tpu.serve.bundle import Bundle, is_bundle, load_bundle
+from paddle_tpu.serve.engine import InferenceEngine
+
+
+def __getattr__(name):
+    if name in ("export_bundle", "verify_bundle"):
+        from paddle_tpu.serve import export as _export
+
+        return getattr(_export, name)
+    raise AttributeError("module 'paddle_tpu.serve' has no attribute %r"
+                         % name)
+
+
+__all__ = ["Bundle", "InferenceEngine", "export_bundle", "is_bundle",
+           "load_bundle", "verify_bundle"]

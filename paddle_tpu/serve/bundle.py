@@ -1,0 +1,221 @@
+"""Load side of the AOT model-bundle format (docs/serving.md).
+
+A bundle directory is the deployment artifact ``serve.export_bundle``
+writes: a versioned ``manifest.json`` (input/output specs, dtypes, the
+exported batch buckets, framework versions), ``params.npz`` (packed
+parameter payload) and one serialized ``jax.export`` artifact per batch
+bucket. :func:`load_bundle` reloads it by **deserialization only** — no
+model-config/layer-graph code runs, which is the whole point: the
+reference's merged-model capi path still re-built the topology at load
+time (capi/bridge.py ``Topology.from_proto``), while a bundle goes
+straight from bytes to a callable XLA executable (TF-Serving
+SavedModelBundle analogue, Olston et al. 2017 §4.1).
+
+This module must stay importable without the graph layer: it may import
+only stdlib, numpy, jax and the dependency-free observe modules.
+tests/test_serve.py enforces the contract with an import blocker in a
+fresh subprocess.
+"""
+
+import json
+import os
+
+import numpy as np
+
+MANIFEST_NAME = "manifest.json"
+BUNDLE_FORMAT = "paddle_tpu-bundle-v1"
+
+# input kinds (manifest "kind") -> flat feed keys the executable consumes:
+#   dense      f32 [B, dim]                      keys: [name]
+#   index      i32 [B]                           keys: [name]
+#   seq_index  i32 [B, T] ids + i32 [B] lengths  keys: [name, name+":lens"]
+#   seq_dense  f32 [B, T, dim] + i32 [B] lengths keys: [name, name+":lens"]
+SEQ_KINDS = ("seq_index", "seq_dense")
+
+
+def is_bundle(path):
+    """True when ``path`` is a bundle directory (manifest present and of
+    the bundle format — a merged-model tar or checkpoint dir is not)."""
+    manifest = os.path.join(path, MANIFEST_NAME)
+    if not (os.path.isdir(path) and os.path.isfile(manifest)):
+        return False
+    try:
+        with open(manifest) as fh:
+            return json.load(fh).get("format") == BUNDLE_FORMAT
+    except (OSError, ValueError):
+        return False
+
+
+def flat_keys(spec):
+    """Flat feed keys of one manifest input spec, in feed order."""
+    if spec["kind"] in SEQ_KINDS:
+        return [spec["name"], spec["name"] + ":lens"]
+    return [spec["name"]]
+
+
+def _np_dtype(name):
+    return np.dtype(name)
+
+
+class Bundle:
+    """A loaded model bundle: manifest + packed params + per-bucket
+    compiled executables (deserialized lazily, cached per bucket — the
+    shape-bucketed warm cache the engine fronts)."""
+
+    def __init__(self, directory):
+        self.directory = os.path.abspath(directory)
+        with open(os.path.join(self.directory, MANIFEST_NAME)) as fh:
+            self.manifest = json.load(fh)
+        if self.manifest.get("format") != BUNDLE_FORMAT:
+            raise ValueError(
+                "%s is not a %s bundle (format=%r)"
+                % (directory, BUNDLE_FORMAT, self.manifest.get("format")))
+        self.name = self.manifest.get("name", "model")
+        self.inputs = self.manifest["inputs"]
+        self.outputs = self.manifest["outputs"]
+        self.seq_len = self.manifest.get("seq_len")
+        # buckets sorted ascending so bucket_for takes the first fit
+        self.buckets = sorted(self.manifest["buckets"],
+                              key=lambda b: b["batch"])
+        if not self.buckets:
+            raise ValueError("bundle %s has no batch buckets" % directory)
+        with np.load(os.path.join(self.directory,
+                                  self.manifest["params_file"])) as pz:
+            self._params = {k: pz[k] for k in pz.files}
+        self._executables = {}  # batch -> jax.export.Exported
+
+    # -- bucket/shape machinery ---------------------------------------------
+    def batch_sizes(self):
+        return [b["batch"] for b in self.buckets]
+
+    def max_batch(self):
+        return self.buckets[-1]["batch"]
+
+    def bucket_for(self, rows):
+        """The smallest exported bucket holding ``rows`` rows."""
+        for b in self.buckets:
+            if b["batch"] >= rows:
+                return b
+        raise ValueError(
+            "batch of %d rows exceeds the largest exported bucket (%d); "
+            "re-export with a larger batch size or split the request"
+            % (rows, self.max_batch()))
+
+    def feed_shape(self, spec, batch):
+        """Shape of one flat feed array (the data array for sequence
+        kinds; lengths are always [batch])."""
+        kind = spec["kind"]
+        if kind == "dense":
+            return (batch, spec["dim"])
+        if kind == "index":
+            return (batch,)
+        if kind == "seq_index":
+            return (batch, self.seq_len)
+        if kind == "seq_dense":
+            return (batch, self.seq_len, spec["dim"])
+        raise ValueError("unknown input kind %r" % kind)
+
+    def dummy_inputs(self, rows=1):
+        """Zero-valued flat inputs for ``rows`` rows (warmup/selfcheck:
+        index ids 0 are always in-vocabulary, sequence lengths run the
+        full exported seq_len)."""
+        out = {}
+        for spec in self.inputs:
+            dtype = _np_dtype(spec["dtype"])
+            out[spec["name"]] = np.zeros(self.feed_shape(spec, rows), dtype)
+            if spec["kind"] in SEQ_KINDS:
+                out[spec["name"] + ":lens"] = np.full(
+                    (rows,), self.seq_len, np.int32)
+        return out
+
+    def validate_inputs(self, flat_inputs):
+        """Value-level checks the compiled executable cannot make: shape
+        mismatches fail loudly at call time, but out-of-range sequence
+        LENGTHS would silently ride the length mask and return plausible
+        garbage. Shared by :meth:`infer` and the engine's submit."""
+        for spec in self.inputs:
+            if spec["kind"] not in SEQ_KINDS:
+                continue
+            key = spec["name"] + ":lens"
+            if key not in flat_inputs:
+                continue
+            lens = np.asarray(flat_inputs[key])
+            if lens.size and (lens.min() < 0 or lens.max() > self.seq_len):
+                raise ValueError(
+                    "input %r: sequence lengths must be in [0, seq_len=%d]"
+                    ", got [%d, %d] — re-export with a larger seq_len for "
+                    "longer sequences" % (spec["name"], self.seq_len,
+                                          int(lens.min()), int(lens.max())))
+
+    # -- execution ----------------------------------------------------------
+    def executable(self, batch):
+        """The deserialized executable for one bucket batch size (cached;
+        first call per bucket pays the deserialize+compile)."""
+        exe = self._executables.get(batch)
+        if exe is None:
+            from jax import export as jax_export
+
+            bucket = next(b for b in self.buckets if b["batch"] == batch)
+            path = os.path.join(self.directory, bucket["artifact"])
+            with open(path, "rb") as fh:
+                exe = jax_export.deserialize(bytearray(fh.read()))
+            self._executables[batch] = exe
+        return exe
+
+    def warmup(self):
+        """Deserialize AND run every bucket once so serving never pays a
+        first-request compile (the engine calls this at start)."""
+        for bucket in self.buckets:
+            batch = bucket["batch"]
+            self.executable(batch).call(self._params,
+                                        self.dummy_inputs(batch))
+        return len(self.buckets)
+
+    def run(self, flat_inputs, batch):
+        """Run one exact-bucket batch (no padding logic). Returns
+        {output_name: np.ndarray}."""
+        out = self.executable(batch).call(self._params, flat_inputs)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def infer(self, flat_inputs, rows=None):
+        """Pad ``flat_inputs`` to the nearest exported bucket, run, slice
+        the padding back off. ``flat_inputs`` maps flat feed keys to
+        arrays with a leading row dimension."""
+        first = next(iter(flat_inputs.values()))
+        rows = int(first.shape[0]) if rows is None else int(rows)
+        if rows < 1:
+            raise ValueError("cannot infer an empty batch (rows=%d)" % rows)
+        self.validate_inputs(flat_inputs)
+        bucket = self.bucket_for(rows)
+        padded = {k: pad_rows(np.asarray(v), bucket["batch"])
+                  for k, v in flat_inputs.items()}
+        out = self.run(padded, bucket["batch"])
+        return {k: v[:rows] for k, v in out.items()}
+
+    def __repr__(self):
+        return "Bundle(%r, buckets=%s, inputs=%s)" % (
+            self.name, self.batch_sizes(),
+            [i["name"] for i in self.inputs])
+
+
+def pad_rows(arr, to_rows):
+    """Pad a batch array to ``to_rows`` rows by replicating the last row
+    — replicated rows are valid model inputs for every input kind (zeros
+    would fabricate length-0 sequences / out-of-distribution ids), and
+    the padding is sliced off after the forward anyway."""
+    n = arr.shape[0]
+    if n == to_rows:
+        return arr
+    if n == 0:
+        raise ValueError("cannot pad an empty batch (no row to replicate)")
+    if n > to_rows:
+        raise ValueError("cannot pad %d rows down to %d" % (n, to_rows))
+    pad = np.repeat(arr[-1:], to_rows - n, axis=0)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def load_bundle(directory):
+    """Load an exported bundle directory. Pure deserialization: the
+    layer/topology machinery is never imported, so this works in a
+    process that has no model-config code at all."""
+    return Bundle(directory)

@@ -1,0 +1,236 @@
+"""Dynamic-batching inference engine over a loaded bundle.
+
+Clipper-style adaptive batching (Crankshaw et al., NSDI 2017 §4.3) in
+front of the bundle's shape-bucketed executables: callers ``submit()``
+row-batches and get a Future; a single worker thread drains the queue
+into device batches under a two-sided flush policy —
+
+* **flush on size**: a batch launches as soon as ``max_batch_size`` rows
+  are queued;
+* **flush on deadline**: a smaller batch launches once the OLDEST queued
+  request has waited ``max_latency_ms`` (per-request latency is bounded
+  by queue wait + one model forward, the TF-Serving batching contract).
+
+Each flushed batch pads up to the nearest exported bucket (replicated
+rows, sliced off after the forward) and runs the bucket's cached
+executable, warmed at engine start so no request ever pays a compile.
+
+Observability: every batch runs inside a ``serve_batch`` span
+(paddle_tpu.observe) and — when telemetry is active or an explicit
+StepLog is passed — emits ``serve_batch``/``serve_request`` steplog
+records (schema v1, tests/golden/steplog_schema.json).
+"""
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from paddle_tpu.observe import spans as observe_spans
+from paddle_tpu.observe import steplog as observe_steplog
+from paddle_tpu.serve.bundle import flat_keys, pad_rows
+
+
+class _Request:
+    __slots__ = ("inputs", "rows", "future", "t_enqueue", "req_id")
+
+    def __init__(self, inputs, rows, req_id):
+        self.inputs = inputs
+        self.rows = rows
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.req_id = req_id
+
+
+class InferenceEngine:
+    """Thread-safe dynamic-batching front end of a :class:`Bundle`.
+
+    ``submit(inputs)`` takes a dict of flat feed arrays (leading row
+    dimension; ``bundle.dummy_inputs()`` shows the expected keys) and
+    returns a ``concurrent.futures.Future`` resolving to
+    ``{output_name: np.ndarray}`` with the same row count. ``infer()``
+    is the blocking convenience. Use as a context manager or call
+    ``stop()`` — pending requests are drained before shutdown.
+    """
+
+    def __init__(self, bundle, max_batch_size=None, max_latency_ms=5.0,
+                 steplog=None, warmup=True, run_name="serve"):
+        self.bundle = bundle
+        self.max_batch_size = int(max_batch_size or bundle.max_batch())
+        if self.max_batch_size > bundle.max_batch():
+            raise ValueError(
+                "max_batch_size %d exceeds the largest exported bucket %d"
+                % (self.max_batch_size, bundle.max_batch()))
+        self.max_latency_ms = float(max_latency_ms)
+        self._expected_keys = set()
+        for spec in bundle.inputs:
+            self._expected_keys.update(flat_keys(spec))
+        self._cv = threading.Condition()
+        self._queue = collections.deque()
+        self._queued_rows = 0
+        self._stopped = False
+        self._req_counter = 0
+        self._batch_counter = 0
+        self._stats = collections.Counter()
+        self._owns_slog = steplog is None
+        self._slog = (observe_steplog.from_env(run_name=run_name,
+                                               meta={"phase": "serve"})
+                      if steplog is None else steplog)
+        if warmup:
+            with observe_spans.span("serve_warmup",
+                                    args={"buckets":
+                                          len(bundle.buckets)}):
+                bundle.warmup()
+        self._worker = threading.Thread(target=self._loop,
+                                        name="serve-batcher", daemon=True)
+        self._worker.start()
+
+    # -- client surface -----------------------------------------------------
+    def submit(self, inputs):
+        """Enqueue one request (arrays with a leading row dim); returns a
+        Future of {output_name: array[rows, ...]}."""
+        inputs = {k: np.asarray(v) for k, v in inputs.items()}
+        if set(inputs) != self._expected_keys:
+            raise KeyError(
+                "request inputs %s do not match the bundle's feed keys %s"
+                % (sorted(inputs), sorted(self._expected_keys)))
+        rows = {int(v.shape[0]) for v in inputs.values()}
+        if len(rows) != 1:
+            raise ValueError("inconsistent row counts across inputs: %s"
+                             % sorted(rows))
+        rows = rows.pop()
+        if not 1 <= rows <= self.max_batch_size:
+            raise ValueError(
+                "request rows %d outside [1, max_batch_size=%d]"
+                % (rows, self.max_batch_size))
+        self.bundle.validate_inputs(inputs)
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("engine is stopped")
+            self._req_counter += 1
+            req = _Request(inputs, rows, self._req_counter)
+            self._queue.append(req)
+            self._queued_rows += rows
+            self._cv.notify_all()
+        return req.future
+
+    def infer(self, inputs, timeout=60.0):
+        return self.submit(inputs).result(timeout=timeout)
+
+    def stats(self):
+        with self._cv:
+            out = dict(self._stats)
+            for key in ("batches", "requests", "rows", "pad_rows",
+                        "flush_on_size", "flush_on_deadline"):
+                out.setdefault(key, 0)
+            out["queued_rows"] = self._queued_rows
+            out["max_batch_size"] = self.max_batch_size
+            out["max_latency_ms"] = self.max_latency_ms
+        return out
+
+    def stop(self, timeout=30.0):
+        """Drain the queue, stop the worker, close an engine-owned
+        steplog. Idempotent."""
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._worker.join(timeout=timeout)
+        if self._owns_slog and self._slog is not None:
+            self._slog.close()
+            self._slog = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- worker -------------------------------------------------------------
+    def _take_batch(self):
+        """Block until the flush policy fires; pop whole requests up to
+        max_batch_size rows. Returns (requests, rows, reason) or None at
+        shutdown with an empty queue."""
+        with self._cv:
+            while not self._queue and not self._stopped:
+                self._cv.wait()
+            if not self._queue:
+                return None  # stopped and drained
+            deadline = self._queue[0].t_enqueue + self.max_latency_ms / 1e3
+            while (self._queued_rows < self.max_batch_size
+                   and not self._stopped):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            reason = ("size" if self._queued_rows >= self.max_batch_size
+                      else ("drain" if self._stopped else "deadline"))
+            batch = [self._queue.popleft()]
+            rows = batch[0].rows
+            while self._queue and (rows + self._queue[0].rows
+                                   <= self.max_batch_size):
+                req = self._queue.popleft()
+                batch.append(req)
+                rows += req.rows
+            self._queued_rows -= rows
+            return batch, rows, reason
+
+    def _loop(self):
+        while True:
+            taken = self._take_batch()
+            if taken is None:
+                return
+            requests, rows, reason = taken
+            try:
+                self._run_batch(requests, rows, reason)
+            except Exception as exc:  # noqa: BLE001 — fail the batch, not the engine
+                for req in requests:
+                    if not req.future.done():
+                        req.future.set_exception(exc)
+                with self._cv:
+                    self._stats["batches_failed"] += 1
+
+    def _run_batch(self, requests, rows, reason):
+        t_start = time.perf_counter()
+        queue_ms_max = (t_start - requests[0].t_enqueue) * 1e3
+        bucket = self.bundle.bucket_for(rows)
+        flat = {}
+        for key in self._expected_keys:
+            cat = (requests[0].inputs[key] if len(requests) == 1
+                   else np.concatenate([r.inputs[key] for r in requests],
+                                       axis=0))
+            flat[key] = pad_rows(cat, bucket["batch"])
+        self._batch_counter += 1
+        batch_id = self._batch_counter
+        with observe_spans.span(
+                "serve_batch",
+                args={"rows": rows, "bucket": bucket["batch"],
+                      "requests": len(requests)}) as scope:
+            out = self.bundle.run(flat, bucket["batch"])
+        infer_ms = scope.dur * 1e3
+        offset = 0
+        t_done = time.perf_counter()
+        for req in requests:
+            result = {k: v[offset:offset + req.rows]
+                      for k, v in out.items()}
+            offset += req.rows
+            if self._slog is not None:
+                self._slog.log_serve_request(
+                    rows=req.rows,
+                    queue_ms=(t_start - req.t_enqueue) * 1e3,
+                    latency_ms=(t_done - req.t_enqueue) * 1e3,
+                    req_id=req.req_id)
+            req.future.set_result(result)
+        if self._slog is not None:
+            self._slog.log_serve_batch(
+                rows=rows, bucket=bucket["batch"], infer_ms=infer_ms,
+                batch_id=batch_id, pad_rows=bucket["batch"] - rows,
+                requests=len(requests), queue_ms_max=queue_ms_max,
+                flush=reason)
+        with self._cv:
+            self._stats["batches"] += 1
+            self._stats["requests"] += len(requests)
+            self._stats["rows"] += rows
+            self._stats["pad_rows"] += bucket["batch"] - rows
+            self._stats["flush_on_" + reason] += 1

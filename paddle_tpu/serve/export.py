@@ -1,0 +1,247 @@
+"""Export side of the AOT model-bundle format (docs/serving.md).
+
+``export_bundle`` AOT-lowers the inference forward of a topology with
+``jax.jit(...)`` + ``jax.export`` once per batch bucket, and writes a
+self-contained bundle directory:
+
+* ``manifest.json``  — versioned specs: inputs/outputs (names, kinds,
+  dims, dtypes), the exported batch buckets, seq_len, framework/jax
+  versions, export platforms.
+* ``params.npz``     — the packed parameter payload (weights are call
+  arguments of the exported function, not baked-in constants, so the
+  per-bucket artifacts stay small and params remain swappable).
+* ``fwd_b{B}.jaxexp``— one serialized StableHLO artifact per bucket.
+
+The load side (:mod:`paddle_tpu.serve.bundle`) replays the artifacts
+without importing any of the graph machinery this module uses — the
+graph is built here, at export time, never again.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from paddle_tpu.data_type import (DENSE, INDEX, SEQ_NONE, SEQ_SINGLE,
+                                  SPARSE_BINARY, SPARSE_FLOAT)
+from paddle_tpu.serve.bundle import BUNDLE_FORMAT, MANIFEST_NAME, Bundle
+from paddle_tpu.utils.error import enforce
+
+DEFAULT_BATCH_SIZES = (1, 8, 32)
+DEFAULT_SEQ_LEN = 64
+
+
+class _InputSpec:
+    __slots__ = ("name", "kind", "dim", "dtype")
+
+    def __init__(self, name, kind, dim, dtype):
+        self.name = name
+        self.kind = kind
+        self.dim = dim
+        self.dtype = dtype
+
+    def as_manifest(self):
+        return {"name": self.name, "kind": self.kind, "dim": self.dim,
+                "dtype": self.dtype}
+
+
+def _input_specs(topology):
+    """Manifest input specs from the topology's data layers. Sparse slots
+    below the sparse_feed_threshold feed as densified [B, dim] rows (the
+    same boundary convert_feed uses), so they export as ``dense``; the
+    padded-id SparseRows path has no fixed exportable shape yet."""
+    from paddle_tpu.utils import flags
+
+    specs = []
+    for name, itype in topology.data_types():
+        if itype.seq_type == SEQ_NONE:
+            if itype.value_type == DENSE:
+                specs.append(_InputSpec(name, "dense", itype.dim, "float32"))
+            elif itype.value_type == INDEX:
+                specs.append(_InputSpec(name, "index", itype.dim, "int32"))
+            elif itype.value_type in (SPARSE_BINARY, SPARSE_FLOAT):
+                enforce(
+                    itype.dim < flags.get_flag("sparse_feed_threshold"),
+                    "input %r: sparse slots at/above sparse_feed_threshold "
+                    "(dim %d) feed as SparseRows, which has no fixed "
+                    "exportable shape; densify or lower the threshold",
+                    name, itype.dim)
+                specs.append(_InputSpec(name, "dense", itype.dim, "float32"))
+            else:
+                raise ValueError("input %r: unexportable value type %r"
+                                 % (name, itype.value_type))
+        elif itype.seq_type == SEQ_SINGLE:
+            if itype.value_type == INDEX:
+                specs.append(_InputSpec(name, "seq_index", itype.dim,
+                                        "int32"))
+            elif itype.value_type == DENSE:
+                specs.append(_InputSpec(name, "seq_dense", itype.dim,
+                                        "float32"))
+            else:
+                raise ValueError(
+                    "input %r: sparse sequence slots are not exportable"
+                    % name)
+        else:
+            raise ValueError(
+                "input %r: nested-sequence slots are not exportable yet"
+                % name)
+    return specs
+
+
+def _make_forward(topology, specs, out_names):
+    """The function that gets AOT-lowered: (params, flat_inputs) ->
+    {output_name: array}. Rebuilds SequenceBatch values from the flat
+    ids+lengths pairs at trace time; test-mode forward (dropout off, BN
+    moving stats from params)."""
+    from paddle_tpu.core.sequence import SequenceBatch
+
+    def forward(params, flat):
+        feed = {}
+        for spec in specs:
+            if spec.kind in ("seq_index", "seq_dense"):
+                feed[spec.name] = SequenceBatch(flat[spec.name],
+                                                flat[spec.name + ":lens"])
+            else:
+                feed[spec.name] = flat[spec.name]
+        values, _ = topology.apply(params, feed, mode="test")
+        out = {}
+        for name in out_names:
+            val = values[name]
+            out[name] = val.data if hasattr(val, "lengths") else val
+        return out
+
+    return forward
+
+
+def export_bundle(output_layer, parameters, out_dir,
+                  batch_sizes=DEFAULT_BATCH_SIZES, seq_len=None,
+                  name=None, platforms=None):
+    """AOT-export the inference forward over ``output_layer`` as a
+    versioned bundle directory; returns the manifest dict.
+
+    ``batch_sizes`` are the exported batch buckets (the serving engine
+    pads each dynamic batch up to the nearest one). ``seq_len`` fixes
+    the padded time dimension of sequence inputs (required only when the
+    model has any; defaults to 64). ``platforms`` optionally lowers for
+    several backends at once (e.g. ``("cpu", "tpu")``) so a bundle
+    exported on a CPU host serves on the chip.
+    """
+    import jax
+    from jax import export as jax_export
+
+    from paddle_tpu.graph import LayerNode
+    from paddle_tpu.topology import Topology
+
+    outputs = ([output_layer] if isinstance(output_layer, LayerNode)
+               else list(output_layer))
+    topology = Topology(outputs)
+    out_names = [o.name for o in outputs]
+    specs = _input_specs(topology)
+    enforce(bool(specs), "topology has no data layers to feed")
+    batch_sizes = sorted({int(b) for b in batch_sizes})
+    enforce(bool(batch_sizes) and batch_sizes[0] >= 1,
+            "batch_sizes must be positive, got %r", batch_sizes)
+    has_seq = any(s.kind in ("seq_index", "seq_dense") for s in specs)
+    if has_seq:
+        seq_len = int(seq_len or DEFAULT_SEQ_LEN)
+    else:
+        seq_len = None
+
+    params = {k: np.asarray(parameters.get(k)) for k in parameters.names()}
+    param_structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                     for k, v in params.items()}
+    forward = _make_forward(topology, specs, out_names)
+    jitted = jax.jit(forward)
+    export_kwargs = {}
+    if platforms is not None:
+        export_kwargs["platforms"] = tuple(platforms)
+
+    os.makedirs(out_dir, exist_ok=True)
+    buckets = []
+    out_specs = None
+    exported_platforms = None
+    for batch in batch_sizes:
+        flat_structs = {}
+        for spec in specs:
+            shape = _feed_shape(spec, batch, seq_len)
+            flat_structs[spec.name] = jax.ShapeDtypeStruct(
+                shape, np.dtype(spec.dtype))
+            if spec.kind in ("seq_index", "seq_dense"):
+                flat_structs[spec.name + ":lens"] = jax.ShapeDtypeStruct(
+                    (batch,), np.int32)
+        exported = jax_export.export(jitted, **export_kwargs)(
+            param_structs, flat_structs)
+        artifact = "fwd_b%d.jaxexp" % batch
+        with open(os.path.join(out_dir, artifact), "wb") as fh:
+            fh.write(exported.serialize())
+        buckets.append({"batch": batch, "artifact": artifact})
+        exported_platforms = list(exported.platforms)
+        if out_specs is None:
+            out_avals = jax.tree_util.tree_unflatten(
+                exported.out_tree, list(exported.out_avals))
+            out_specs = [
+                {"name": n,
+                 "dtype": str(np.dtype(out_avals[n].dtype)),
+                 "shape_suffix": [int(d) for d in out_avals[n].shape[1:]]}
+                for n in out_names]
+
+    params_file = "params.npz"
+    with open(os.path.join(out_dir, params_file), "wb") as fh:
+        parameters.to_npz(fh)
+
+    from paddle_tpu.core import dtype as dtype_mod
+
+    cd = dtype_mod.compute_dtype()
+    manifest = {
+        "format": BUNDLE_FORMAT,
+        "version": 1,
+        "name": name or out_names[0],
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "framework": {
+            "paddle_tpu": _paddle_tpu_version(),
+            "jax": jax.__version__,
+        },
+        "platforms": exported_platforms,
+        "compute_dtype": str(np.dtype(cd)) if cd is not None else "float32",
+        "inputs": [s.as_manifest() for s in specs],
+        "outputs": out_specs,
+        "seq_len": seq_len,
+        "buckets": buckets,
+        "params_file": params_file,
+    }
+    with open(os.path.join(out_dir, MANIFEST_NAME), "w") as fh:
+        json.dump(manifest, fh, indent=2)
+    return manifest
+
+
+def _feed_shape(spec, batch, seq_len):
+    if spec.kind == "dense":
+        return (batch, spec.dim)
+    if spec.kind == "index":
+        return (batch,)
+    if spec.kind == "seq_index":
+        return (batch, seq_len)
+    if spec.kind == "seq_dense":
+        return (batch, seq_len, spec.dim)
+    raise ValueError("unknown input kind %r" % spec.kind)
+
+
+def _paddle_tpu_version():
+    import paddle_tpu
+
+    return paddle_tpu.__version__
+
+
+def verify_bundle(out_dir):
+    """Reload the just-written bundle in THIS process and run its
+    smallest bucket on dummy inputs — the cheap export-time smoke that
+    the artifacts deserialize and execute, run by ``cli export`` on
+    every bundle it writes (the cross-process equivalence check lives in
+    tests/test_serve.py and ``cli serve --selfcheck``)."""
+    bundle = Bundle(out_dir)
+    out = bundle.infer(bundle.dummy_inputs(1))
+    for name, arr in out.items():
+        enforce(np.all(np.isfinite(arr)),
+                "bundle selfcheck: output %r is not finite", name)
+    return {k: v.shape for k, v in out.items()}

@@ -1,0 +1,109 @@
+"""Minimal HTTP front end for a serving engine (stdlib only).
+
+``paddle_tpu.cli serve <bundle>`` wires a loaded bundle + batching
+engine behind three endpoints:
+
+* ``POST /infer``   — body ``{"inputs": {flat_key: nested_lists}}``;
+  responds ``{"outputs": {name: nested_lists}}``. Dtypes come from the
+  bundle manifest, so clients send plain JSON numbers.
+* ``GET /healthz``  — ``{"ok": true, "bundle": <name>}`` once the
+  engine is warmed (a liveness/readiness probe).
+* ``GET /stats``    — engine counters (batches, rows, flush reasons).
+* ``GET /manifest`` — the bundle manifest (model discovery, TF-Serving
+  GetModelMetadata analogue).
+
+This is deliberately a thin demo/ops surface over the real subsystem
+(bundle + engine); production serving would put the PJRT-C-API path
+(docs/serving.md) or a proper RPC stack in front of the same engine.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from paddle_tpu.serve.bundle import SEQ_KINDS, flat_keys
+
+
+def _request_arrays(bundle, payload):
+    """JSON request inputs -> typed flat feed arrays."""
+    inputs = payload.get("inputs")
+    if not isinstance(inputs, dict):
+        raise ValueError('request body must be {"inputs": {...}}')
+    dtypes = {}
+    for spec in bundle.inputs:
+        keys = flat_keys(spec)
+        dtypes[keys[0]] = np.dtype(spec["dtype"])
+        if spec["kind"] in SEQ_KINDS:
+            dtypes[keys[1]] = np.int32
+    out = {}
+    for key, value in inputs.items():
+        if key not in dtypes:
+            raise ValueError("unknown input %r (expected %s)"
+                             % (key, sorted(dtypes)))
+        out[key] = np.asarray(value, dtype=dtypes[key])
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    engine = None
+    bundle = None
+
+    def _send(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # route through our logger, quietly
+        from paddle_tpu.utils.logger import logger
+
+        logger.debug("serve http: " + fmt, *args)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._send(200, {"ok": True, "bundle": self.bundle.name})
+        elif self.path == "/stats":
+            self._send(200, self.engine.stats())
+        elif self.path == "/manifest":
+            self._send(200, self.bundle.manifest)
+        else:
+            self._send(404, {"error": "unknown path %s" % self.path})
+
+    def do_POST(self):
+        if self.path != "/infer":
+            self._send(404, {"error": "unknown path %s" % self.path})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            arrays = _request_arrays(self.bundle, payload)
+            result = self.engine.infer(
+                arrays, timeout=float(payload.get("timeout_s", 60.0)))
+            self._send(200, {"outputs": {k: np.asarray(v).tolist()
+                                         for k, v in result.items()}})
+        except (ValueError, KeyError) as exc:
+            self._send(400, {"error": str(exc)})
+        except Exception as exc:  # noqa: BLE001 — surface, don't kill the server
+            self._send(500, {"error": str(exc)})
+
+
+def make_server(bundle, engine, host="127.0.0.1", port=0):
+    """A ThreadingHTTPServer bound to (host, port); ``port=0`` picks a
+    free port (``server.server_address[1]`` is the actual one)."""
+    handler = type("BundleHandler", (_Handler,),
+                   {"engine": engine, "bundle": bundle})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_in_thread(bundle, engine, host="127.0.0.1", port=0):
+    """Start the server on a daemon thread; returns (server, thread) —
+    tests and notebooks use this, the CLI uses serve_forever."""
+    server = make_server(bundle, engine, host, port)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="serve-http", daemon=True)
+    thread.start()
+    return server, thread

@@ -424,6 +424,17 @@ class SGD:
         self._sync_back()
         self.parameters.to_tar(f)
 
+    def export_inference_bundle(self, output_layer, out_dir, **export_kw):
+        """Sync the trained parameters back and AOT-export the inference
+        forward over ``output_layer`` as a serve bundle (docs/serving.md;
+        paddle_tpu.serve.export_bundle kwargs pass through). The train →
+        export → serve demo path: demos/fit_a_line/train.py."""
+        from paddle_tpu.serve.export import export_bundle
+
+        self._sync_back()
+        return export_bundle(output_layer, self.parameters, out_dir,
+                             **export_kw)
+
     # -- checkpoint/resume (pserver doCheckpoint + ParamUtil parity) --------
     def save_checkpoint(self, directory, pass_id=0, keep=3,
                         coordinator=None):

@@ -40,6 +40,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: subprocess-heavy tests excluded from the tier-1 run "
+        "(-m 'not slow'); run them with -m slow")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(1234)

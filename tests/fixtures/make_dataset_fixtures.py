@@ -125,11 +125,23 @@ def make_wmt14(path):
         _add_bytes(tar, "wmt14/test/test", test.encode())
 
 
+def make_uci_housing(path, rows=10):
+    """A 10-row housing.data in the REAL UCI layout: 14 whitespace-
+    separated columns per line (13 features + price), fixed-width float
+    formatting like the original file. Deterministic (seed 7)."""
+    rng = np.random.RandomState(7)
+    data = rng.uniform(0.1, 100.0, size=(rows, 14)).round(4)
+    with open(path, "w") as f:
+        for row in data:
+            f.write(" ".join("%9.4f" % v for v in row) + "\n")
+
+
 def main():
     make_imdb(os.path.join(HERE, "aclImdb_v1.tar.gz"))
     make_cifar10(os.path.join(HERE, "cifar-10-python.tar.gz"))
     make_conll05(os.path.join(HERE, "conll05st-tests.tar.gz"), HERE)
     make_wmt14(os.path.join(HERE, "wmt14.tgz"))
+    make_uci_housing(os.path.join(HERE, "housing.data"))
     print("fixtures written to", HERE)
 
 

@@ -68,3 +68,48 @@ def test_bench_print_applies_sanitizer(capsys):
     bench._EMITTED.pop(rec["metric"], None)
     if rec["metric"] in bench._EMIT_ORDER:
         bench._EMIT_ORDER.remove(rec["metric"])
+
+
+# -- serving rows (benchmark/exp_serve.py): reject, don't demote -------------
+
+import pytest
+
+
+def _serving_row():
+    """A sane exp_serve row: qps value + latency percentiles."""
+    return {"metric": "serve_mlp_qps_c8", "value": 1234.5, "unit": "qps",
+            "p50_ms": 4.2, "p99_ms": 9.8, "requests": 400, "batches": 71,
+            "clients": 8, "max_batch": 32, "max_latency_ms": 5.0}
+
+
+def test_serving_row_sane_passes_through():
+    rec = _serving_row()
+    out = sanitize_bench_row(dict(rec))
+    assert out == rec  # untouched, no notes
+
+
+def test_serving_row_p99_below_p50_rejected():
+    """Percentiles of ONE latency sample are monotone in the quantile —
+    p99 < p50 can only mean broken measurement code; such a row has no
+    honest demoted form (contrast wall<device, where device survives)."""
+    row = _serving_row()
+    row["p99_ms"] = 1.0
+    with pytest.raises(ValueError, match="p99_ms .* < p50_ms"):
+        sanitize_bench_row(row)
+
+
+def test_serving_row_nonpositive_qps_rejected():
+    row = _serving_row()
+    row["value"] = 0.0
+    with pytest.raises(ValueError, match="qps"):
+        sanitize_bench_row(row)
+    with pytest.raises(ValueError, match="qps"):
+        sanitize_bench_row({"metric": "m", "qps": -3.0})
+
+
+def test_serving_fields_do_not_touch_training_rows():
+    """A training row with neither percentiles nor a qps unit must be
+    immune to the serving invariants (value 0 is demote-worthy there,
+    not reject-worthy)."""
+    rec = {"metric": "resnet50_ms", "value": 0.0, "unit": "ms/batch"}
+    assert sanitize_bench_row(dict(rec)) == rec

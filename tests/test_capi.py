@@ -177,3 +177,49 @@ def test_c_sparse_binary_inference_example(capi_builders):
         out_layer, params, [([1, 5, 7],), ([0, 2],)])
     np.testing.assert_allclose(np.asarray(rows), expected, rtol=1e-4,
                                atol=1e-5)
+
+
+# -- bundle-backed inference (docs/serving.md, Python-free path) -------------
+
+@pytest.fixture(scope="module")
+def capi_bundle(capi_example, tmp_path_factory):
+    """The same MLP exported as an AOT serve bundle: the C client loads
+    it by passing the bundle DIRECTORY where the params tar would go and
+    an empty builder — the embedded Python side then does pure
+    deserialization, no topology/layer-graph construction."""
+    params_tar, params, out_layer = capi_example
+    tmp = tmp_path_factory.mktemp("capi_bundle")
+    from paddle_tpu.serve.export import export_bundle
+
+    bundle_dir = str(tmp / "mlp_bundle")
+    export_bundle(out_layer, params, bundle_dir, batch_sizes=(1,),
+                  name="capi_mlp")
+    return bundle_dir
+
+
+def test_c_program_bundle_inference_equivalence(capi_example, capi_bundle):
+    """The unchanged infer_dense C binary drives the exported MNIST
+    dense bundle (empty builder + bundle dir) and matches both the live
+    Python inference and the tar-backed C run."""
+    params_tar, params, out_layer = capi_example
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["LD_LIBRARY_PATH"] = CAPI_DIR
+    proc = subprocess.run(
+        [os.path.join(CAPI_DIR, "examples", "infer_dense"),
+         "", capi_bundle, "784"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "C-API OK" in proc.stdout
+    row = [0.1 * (i % 10) for i in range(784)]
+    import paddle_tpu as paddle
+
+    expected = paddle.inference.infer(
+        out_layer, params, [(np.asarray(row, np.float32),)])
+    out_line = [l for l in proc.stdout.splitlines()
+                if l.startswith("output")][0]
+    got = np.array([float(v) for v in out_line.split(":")[1].split()])
+    np.testing.assert_allclose(got, expected[0][:len(got)], rtol=1e-4,
+                               atol=1e-6)

@@ -209,3 +209,50 @@ def test_conll05_real_data_trains(data_home):
     changed = any(not np.array_equal(before[n], params.get(n))
                   for n in params.names())
     assert changed, "training on real-parsed data updated nothing"
+
+
+# ---- UCI housing ----------------------------------------------------------
+
+def test_uci_housing_real_parse(data_home):
+    """The REAL whitespace-separated 14-column format: normalization
+    stats over the WHOLE file before the 80/20 split (reference v2
+    load_data), price column untouched — exact values, not just shapes."""
+    from paddle_tpu.dataset import uci_housing
+
+    _stage(data_home, "uci_housing", "housing.data")
+    train = list(uci_housing.train()())
+    test = list(uci_housing.test()())
+    assert len(train) == 8 and len(test) == 2  # 10 fixture rows, 80/20
+    x, y = train[0]
+    assert x.shape == (13,) and x.dtype == np.float32
+    assert y.shape == (1,) and y.dtype == np.float32
+
+    raw = np.loadtxt(os.path.join(FIXTURES, "housing.data"))
+    maxs, mins, avgs = raw.max(axis=0), raw.min(axis=0), raw.mean(axis=0)
+    want = (raw[0, :13] - avgs[:13]) / (maxs[:13] - mins[:13])
+    np.testing.assert_allclose(x, want, rtol=1e-5)
+    np.testing.assert_allclose(y[0], raw[0, 13], rtol=1e-6)
+    # the test split continues where train stopped, same normalization
+    np.testing.assert_allclose(
+        test[0][0], (raw[8, :13] - avgs[:13]) / (maxs[:13] - mins[:13]),
+        rtol=1e-5)
+    np.testing.assert_allclose(test[0][1][0], raw[8, 13], rtol=1e-6)
+
+
+def test_uci_housing_malformed_file_rejected(data_home, tmp_path):
+    from paddle_tpu.dataset import uci_housing
+
+    bad = tmp_path / "housing.data"
+    bad.write_text("1.0 2.0 3.0\n")  # not 14 columns
+    with pytest.raises(ValueError, match="14 whitespace-separated"):
+        uci_housing.load_data(str(bad))
+
+
+def test_uci_housing_synthetic_fallback(data_home):
+    from paddle_tpu.dataset import uci_housing
+
+    train = list(uci_housing.train(synthetic_size=7)())
+    assert len(train) == 7
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert x.dtype == np.float32 and y.dtype == np.float32

@@ -93,3 +93,13 @@ def test_real_digits_demo_reaches_97_percent():
     spec.loader.exec_module(mod)
     acc = mod.main(num_passes=60, quiet=True)
     assert acc >= 0.97, acc
+
+
+@pytest.mark.slow
+def test_fit_a_line_demo(tmp_path):
+    """train → export → bundle-reload-check on uci_housing (the
+    dense-regression demo bundle, docs/serving.md)."""
+    out = run_demo("fit_a_line", "train.py",
+                   ["--quick", "--export", str(tmp_path / "bundle")])
+    assert "test cost" in out
+    assert "bundle reload matches live inference" in out

@@ -424,6 +424,11 @@ def _full_featured_log(tmp_path):
         slog.log_step(step=2, wall_ms=3.0)
         slog.write({"type": "event", "event": "compile", "secs": 0.01})
         slog.write({"type": "bench_row", "metric": "x", "value": 1.0})
+        slog.log_serve_request(rows=1, queue_ms=0.5, latency_ms=2.5,
+                               req_id=1)
+        slog.log_serve_batch(rows=3, bucket=4, infer_ms=1.2, batch_id=1,
+                             pad_rows=1, requests=2, queue_ms_max=0.7,
+                             flush="deadline")
         slog.log_pass(0, metrics={"err": 0.25})
     return steplog.read_jsonl(os.path.join(str(tmp_path),
                                            "unit.steps.jsonl"))
@@ -577,3 +582,27 @@ def test_trainer_without_telemetry_writes_nothing(tmp_path, monkeypatch):
     trainer, reader, _ = _dense_toy(n_batches=2)
     trainer.train(reader, num_passes=1)
     assert glob.glob(str(tmp_path / "*.jsonl")) == []
+
+
+# -- benchmark.traceutil compat shim ----------------------------------------
+
+def test_traceutil_shim_deprecation_and_equivalence():
+    """The shim must (a) emit ONE DeprecationWarning at import pointing
+    at paddle_tpu.observe.attribution and (b) stay import-equivalent —
+    every re-exported symbol IS the attribution object, so old callers
+    and new callers share state."""
+    import importlib
+    import sys
+    import warnings
+
+    sys.modules.pop("benchmark.traceutil", None)
+    with pytest.warns(DeprecationWarning,
+                      match="paddle_tpu.observe.attribution"):
+        shim = importlib.import_module("benchmark.traceutil")
+    for name in ("DeviceTrace", "capture", "device_busy_ms",
+                 "parse_trace_dir", "parse_trace_files"):
+        assert getattr(shim, name) is getattr(attribution, name), name
+    # one-time: a second import of the cached module must not warn again
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        importlib.import_module("benchmark.traceutil")
