@@ -55,13 +55,82 @@ _EMITTED = {}
 _EMIT_ORDER = []
 
 
+_GATE_FAILURES = []  # regress results that gated, for _gate_exit
+_AUDITED_BEST = None  # lazy cache of the checked-in audited best map
+
+
+def _regress_check(rec):
+    """Run one freshly emitted row through the spread-aware regression
+    gate (paddle_tpu.observe.regress) against the checked-in audited
+    set (BENCH_*.json + BASELINE.json). Warn-only by default: a gated
+    regression annotates the row and prints a warning line;
+    PADDLE_TPU_BENCH_GATE=hard additionally fails the run at the end
+    (_gate_exit — never mid-run, so every row still gets measured and
+    re-emitted). Returns the result dict (or None when ungateable).
+    sanitize_bench_row stays the unconditional first line of defense —
+    the row reaching here is already sanitized."""
+    global _AUDITED_BEST
+    try:
+        from paddle_tpu.observe import regress
+    except Exception:
+        return None
+    try:
+        if _AUDITED_BEST is None:
+            _AUDITED_BEST = regress.best_audited(
+                regress.default_audit_paths(
+                    os.path.dirname(os.path.abspath(__file__))))
+        result = regress.check_row(rec, _AUDITED_BEST, sanitize=False)
+    except Exception as exc:  # the gate must never sink the bench
+        print(json.dumps({"metric": "regress_gate_error",
+                          "error": repr(exc)[:200]}), flush=True)
+        return None
+    if result["status"] == "regression":
+        rec["regress_note"] = regress.format_result(result)
+        _GATE_FAILURES.append(result)
+        print("WARNING: " + rec["regress_note"], file=sys.stderr,
+              flush=True)
+    return result
+
+
+def _gate_summary():
+    """Summary row for a run that gated rows (emitted through _print
+    BEFORE the tail re-emission, so the flagship still owns the final
+    line the driver's last-line parser reads)."""
+    if not _GATE_FAILURES:
+        return
+    # import only past the early return: _GATE_FAILURES can be non-empty
+    # only if _regress_check's own guarded import already succeeded
+    from paddle_tpu.observe import regress
+
+    _print({"metric": "bench_regression_gate",
+            "value": len(_GATE_FAILURES), "unit": "gated_rows",
+            "mode": "hard" if regress.hard_gate() else "warn",
+            "gated": [r["metric"] for r in _GATE_FAILURES]})
+
+
+def _gate_exit():
+    """End-of-run verdict: SystemExit(3) when PADDLE_TPU_BENCH_GATE=hard
+    and any row gated (after the full tail re-emission — a failed gate
+    must not erase the measured record)."""
+    if not _GATE_FAILURES:
+        return
+    from paddle_tpu.observe import regress
+
+    if regress.hard_gate():
+        raise SystemExit(3)
+
+
 def _print(rec):
     # every emitted record passes the audited-row invariants (no
     # wall_ms < device_ms, no spread_pct > 100 — the r5 tagging row
-    # shipped both; VERDICT r5 weak #3)
+    # shipped both; VERDICT r5 weak #3), then the spread-aware
+    # regression gate vs the audited BENCH trajectory (warn-only unless
+    # PADDLE_TPU_BENCH_GATE=hard)
     from benchmark.harness import sanitize_bench_row
 
     rec = sanitize_bench_row(rec)
+    if not rec.get("reemit"):
+        _regress_check(rec)
     metric = rec.get("metric")
     if metric:
         if metric not in _EMITTED:
@@ -618,7 +687,11 @@ def main():
     # ---- final lines: re-emit EVERY collected record, headline rows last
     # (the driver records only the output tail; after this block the tail
     # IS the complete audited record, flagship on the very last line) ------
+    _gate_summary()
     _reemit_tail()
+    # regression-gate verdict: warn-only by default,
+    # PADDLE_TPU_BENCH_GATE=hard exits 3 on any gated row
+    _gate_exit()
 
 
 def streamed_ms(bundle, n1, n2):

@@ -340,8 +340,13 @@ def cmd_serve(args):
     from paddle_tpu.serve import InferenceEngine, load_bundle
 
     bundle = load_bundle(args.bundle)
+    # serving path: warm asynchronously so the HTTP endpoints bind
+    # immediately and the readiness probe (/healthz, /readyz) honestly
+    # reports ready=false until every bucket is warm; selfcheck warms
+    # synchronously — it IS the warmth gate
     engine = InferenceEngine(bundle, max_batch_size=args.max_batch_size,
-                             max_latency_ms=args.max_latency_ms)
+                             max_latency_ms=args.max_latency_ms,
+                             warmup=(True if args.selfcheck else "async"))
     if args.selfcheck:
         try:
             out = engine.infer(bundle.dummy_inputs(rows=1), timeout=300.0)
@@ -357,7 +362,8 @@ def cmd_serve(args):
     from paddle_tpu.serve.server import make_server
 
     server = make_server(bundle, engine, host=args.host, port=args.port)
-    print("serving %r on http://%s:%d (POST /infer, GET /healthz)"
+    print("serving %r on http://%s:%d (POST /infer; GET /healthz "
+          "/readyz /metrics /stats /manifest)"
           % (bundle.name, *server.server_address))
     try:
         server.serve_forever()
@@ -371,14 +377,38 @@ def cmd_serve(args):
 
 def cmd_observe(args):
     """Summarize a PADDLE_TPU_TELEMETRY directory: per-run step counts,
-    steady-state wall times, compile-event totals, and the trace files
-    to open in Perfetto (docs/observability.md)."""
+    steady-state wall-time p50/p95/p99, compile-event totals, and the
+    trace files to open in Perfetto (docs/observability.md). With
+    ``--regress <baseline.json>`` the ``bench_row`` records mirrored
+    into the directory are gated against the audited baseline
+    (observe/regress.py) and a gated regression exits non-zero — the CI
+    one-liner."""
     from paddle_tpu.observe import steplog
 
     summary = steplog.summarize_dir(args.directory)
+    rc = 0
+    regress_results = None
+    if args.regress:
+        import glob as _glob
+
+        from paddle_tpu.observe import regress as observe_regress
+
+        rows = []
+        for path in sorted(_glob.glob(
+                os.path.join(args.directory, "*.steps.jsonl"))):
+            rows.extend(r for r in steplog.read_jsonl(path)
+                        if r.get("type") == "bench_row")
+        results, regressions = observe_regress.gate_rows(
+            rows, baseline_paths=[args.regress],
+            base_tol_pct=args.regress_tol)
+        regress_results = results
+        if regressions:
+            rc = 1
     if args.json:
+        if regress_results is not None:
+            summary["regress"] = regress_results
         print(json.dumps(summary, indent=2))
-        return 0
+        return rc
     print("telemetry dir: %s" % summary["directory"])
     for run in summary["runs"]:
         print("  run %-12s schema=%s backend=%-5s steps=%-5d "
@@ -387,10 +417,12 @@ def cmd_observe(args):
                  run["steps"], run["compile_events"],
                  run["event_secs_total"]))
         if "wall_ms_steady_mean" in run:
-            print("    wall ms/step: steady mean %.3f  min %.3f  "
+            print("    wall ms/step: steady p50 %.3f  p95 %.3f  "
+                  "p99 %.3f  mean %.3f  min %.3f  "
                   "(first-step mean incl. compile %.3f)"
-                  % (run["wall_ms_steady_mean"], run["wall_ms_min"],
-                     run["wall_ms_mean"]))
+                  % (run["wall_ms_p50"], run["wall_ms_p95"],
+                     run["wall_ms_p99"], run["wall_ms_steady_mean"],
+                     run["wall_ms_min"], run["wall_ms_mean"]))
         if "examples_per_sec_best" in run:
             print("    examples/sec best: %.1f"
                   % run["examples_per_sec_best"])
@@ -402,7 +434,17 @@ def cmd_observe(args):
               % ", ".join(summary["trace_files"]))
     if not summary["runs"]:
         print("  no *.steps.jsonl runs found")
-    return 0
+    if regress_results is not None:
+        from paddle_tpu.observe.regress import format_result
+
+        gated = [r for r in regress_results
+                 if r["status"] == "regression"]
+        print("  regression gate vs %s: %d row(s) checked, %d gated"
+              % (args.regress, len(regress_results), len(gated)))
+        for r in regress_results:
+            if r["status"] in ("regression", "ok"):
+                print("    " + format_result(r))
+    return rc
 
 
 def main(argv=None):
@@ -451,6 +493,14 @@ def main(argv=None):
                    help="telemetry directory (PADDLE_TPU_TELEMETRY)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable summary")
+    p.add_argument("--regress", default="",
+                   help="audited baseline JSON (a BENCH_*.json driver "
+                        "record or a bench-row lines file); gates the "
+                        "dir's bench_row records and exits non-zero on "
+                        "a gated regression (observe/regress.py)")
+    p.add_argument("--regress-tol", type=float, default=10.0,
+                   help="base tolerance %% before the row's own "
+                        "spread_pct widens it")
     p.set_defaults(fn=cmd_observe)
 
     p = sub.add_parser("merge_model")

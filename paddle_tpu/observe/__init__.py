@@ -15,6 +15,17 @@ profiler window). Three pieces behind one package:
 * :mod:`paddle_tpu.observe.steplog` — per-step JSONL telemetry sink with
   a stable documented schema (docs/observability.md), activated by
   ``PADDLE_TPU_TELEMETRY=<dir>``.
+* :mod:`paddle_tpu.observe.metrics` — process-wide registry of counters,
+  gauges and fixed-bucket latency histograms (exact p50/p95/p99 readout),
+  rendered as Prometheus text exposition (``GET /metrics`` on the serve
+  front end) and as a JSON snapshot.
+* :mod:`paddle_tpu.observe.sentinel` — training flight recorder (ring of
+  the last N step records, dumped as a ``crash_report`` on exception or
+  trip) plus the NaN/Inf-loss and loss-divergence sentinel
+  (``PADDLE_TPU_SENTINEL``: warn by default, ``halt`` raises).
+* :mod:`paddle_tpu.observe.regress` — spread-aware bench regression gate
+  against the audited ``BENCH_*.json``/``BASELINE.json`` record
+  (``PADDLE_TPU_BENCH_GATE=hard`` fails a regressed bench run).
 
 Everything degrades to a no-op when profiling is unavailable: spans always
 work (pure host timing), attribution returns None without a usable
@@ -22,6 +33,8 @@ profiler backend, and the steplog is simply not created without the env
 flag.
 """
 
-from paddle_tpu.observe import attribution, spans, steplog  # noqa: F401
+from paddle_tpu.observe import (attribution, metrics, regress,  # noqa: F401
+                                sentinel, spans, steplog)
+from paddle_tpu.observe.metrics import get_registry  # noqa: F401
 from paddle_tpu.observe.spans import get_tracer, span  # noqa: F401
 from paddle_tpu.observe.steplog import StepLog, from_env, telemetry_dir  # noqa: F401

@@ -1,0 +1,352 @@
+"""Process-wide metrics registry: counters, gauges, latency histograms.
+
+The online half of the observability stack (the steplog/spans are the
+offline half): a thread-safe registry of named instruments that every
+hot surface updates in place — the serving engine (request/row/batch
+counters, queue-depth and in-flight gauges, per-bucket fill/waste
+ratios, latency histograms), the HTTP front end (``GET /metrics``), and
+the trainer (steps, examples/s, loss). Reference lineage:
+``paddle/utils/Stat.h``'s REGISTER_TIMER registry held aggregate timers
+for a log dump at pass end; a fleet serving millions of users needs the
+same aggregates *scrapeable while the process runs*, so this registry
+renders in two formats:
+
+* :meth:`MetricsRegistry.to_prometheus` — Prometheus text exposition
+  (version 0.0.4: ``# HELP``/``# TYPE`` headers, ``_bucket``/``_sum``/
+  ``_count`` histogram series with cumulative ``le`` buckets) for
+  scrapers;
+* :meth:`MetricsRegistry.snapshot` — a JSON-able dict for ``/stats``-
+  style introspection and tests.
+
+Histograms are fixed-bucket for the exposition (so scrapers can compute
+quantiles across processes) AND keep a bounded reservoir of raw
+observations for an exact in-process p50/p95/p99 readout — the bucket
+interpolation error of ``histogram_quantile`` is unacceptable for the
+single-process latency numbers the regression gate and ``/stats``
+publish.
+
+This module must stay dependency-free (stdlib only): it is imported by
+``serve/bundle.py``-adjacent code that runs in graph-free processes.
+"""
+
+import threading
+
+# Default latency buckets in MILLISECONDS (the unit every latency metric
+# in this codebase uses). Upper bounds; +Inf is implicit.
+DEFAULT_LATENCY_BUCKETS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+# raw observations kept per histogram for the exact percentile readout;
+# bounded so a long-lived server cannot grow without limit (the bucket
+# counters remain exact forever — only the percentile window slides)
+RESERVOIR_SIZE = 8192
+
+
+def percentile(values, q):
+    """Exact percentile of a sequence (linear interpolation between
+    order statistics, numpy's default). ``q`` in [0, 100]. Returns None
+    on an empty sequence. Shared by the histogram readout and the
+    steplog step-time summary so the two can never disagree."""
+    vals = sorted(values)
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return float(vals[0])
+    rank = (len(vals) - 1) * (q / 100.0)
+    lo = int(rank)
+    hi = min(lo + 1, len(vals) - 1)
+    frac = rank - lo
+    return float(vals[lo] * (1.0 - frac) + vals[hi] * frac)
+
+
+def _fmt(value):
+    """Prometheus sample value: integral floats render as integers so
+    the exposition is stable across int/float call sites; non-finite
+    values use the exposition spellings (NaN/+Inf/-Inf)."""
+    if isinstance(value, float):
+        if value != value:
+            return "NaN"
+        if value == float("inf"):
+            return "+Inf"
+        if value == float("-inf"):
+            return "-Inf"
+        if not value.is_integer():
+            return repr(value)
+    return str(int(value))
+
+
+def _escape_label(value):
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels_suffix(labels, extra=None):
+    items = list((labels or {}).items())
+    if extra:
+        items += list(extra.items())
+    if not items:
+        return ""
+    inner = ",".join('%s="%s"' % (k, _escape_label(v))
+                     for k, v in sorted(items))
+    return "{%s}" % inner
+
+
+class Counter:
+    """Monotonically increasing count. ``inc()`` only goes up."""
+
+    kind = "counter"
+
+    def __init__(self, name, labels=None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount=1):
+        if amount < 0:
+            raise ValueError("counter %s cannot decrease (inc %r)"
+                             % (self.name, amount))
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """A value that goes up and down (queue depth, in-flight, loss)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, labels=None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount=1):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1):
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with an exact-percentile reservoir.
+
+    ``observe(v)`` is O(len(buckets)); the exposition renders cumulative
+    ``le`` buckets plus ``_sum``/``_count``; :meth:`percentile` reads an
+    exact quantile over the last :data:`RESERVOIR_SIZE` observations."""
+
+    kind = "histogram"
+
+    def __init__(self, name, buckets=DEFAULT_LATENCY_BUCKETS_MS,
+                 labels=None):
+        import collections
+
+        self.name = name
+        self.labels = dict(labels or {})
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram %s needs at least one bucket"
+                             % name)
+        self._lock = threading.Lock()
+        self._bucket_counts = [0] * len(self.buckets)  # non-cumulative
+        self._count = 0
+        self._sum = 0.0
+        self._recent = collections.deque(maxlen=RESERVOIR_SIZE)
+
+    def observe(self, value):
+        value = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += value
+            self._recent.append(value)
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    self._bucket_counts[i] += 1
+                    break
+
+    def percentile(self, q):
+        """Exact percentile over the recent-observation window (None
+        when nothing has been observed)."""
+        with self._lock:
+            recent = list(self._recent)
+        return percentile(recent, q)
+
+    def percentiles(self):
+        """{"p50": ..., "p95": ..., "p99": ...} — the readout the serve
+        ``/stats`` endpoint and the regression gate consume."""
+        with self._lock:
+            recent = list(self._recent)
+        return {"p50": percentile(recent, 50),
+                "p95": percentile(recent, 95),
+                "p99": percentile(recent, 99)}
+
+    def state(self):
+        """(count, sum, cumulative bucket counts) under one lock."""
+        with self._lock:
+            cumulative = []
+            running = 0
+            for c in self._bucket_counts:
+                running += c
+                cumulative.append(running)
+            return self._count, self._sum, cumulative
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+
+class MetricsRegistry:
+    """Thread-safe get-or-create registry of instruments.
+
+    One instrument per (name, labels) pair; re-requesting returns the
+    SAME object, so independent call sites (two engines, the trainer and
+    a test) share process-wide series. A name is bound to one kind —
+    re-registering ``foo`` as a gauge after it was a counter is a bug
+    and raises."""
+
+    def __init__(self, name="paddle_tpu"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._metrics = {}  # (name, labels_key) -> instrument
+        self._kinds = {}    # name -> kind
+        self._helps = {}    # name -> help string
+        self._order = []    # family names in first-registration order
+
+    def _get(self, cls, name, help, labels, **kw):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            existing_kind = self._kinds.get(name)
+            if existing_kind is not None and existing_kind != cls.kind:
+                raise ValueError(
+                    "metric %r already registered as a %s, cannot "
+                    "re-register as a %s" % (name, existing_kind, cls.kind))
+            inst = self._metrics.get(key)
+            if inst is None:
+                inst = cls(name, labels=labels, **kw)
+                self._metrics[key] = inst
+                if name not in self._kinds:
+                    self._kinds[name] = cls.kind
+                    self._order.append(name)
+                if help and name not in self._helps:
+                    self._helps[name] = help
+            return inst
+
+    def counter(self, name, help="", labels=None):
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name, help="", labels=None):
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name, help="", labels=None,
+                  buckets=DEFAULT_LATENCY_BUCKETS_MS):
+        return self._get(Histogram, name, help, labels, buckets=buckets)
+
+    def _families(self):
+        """[(name, kind, help, [instruments])] in registration order,
+        instruments sorted by label set for a stable exposition."""
+        with self._lock:
+            metrics = dict(self._metrics)
+            order = list(self._order)
+            kinds = dict(self._kinds)
+            helps = dict(self._helps)
+        by_name = {}
+        for (name, labels_key), inst in sorted(metrics.items()):
+            by_name.setdefault(name, []).append(inst)
+        return [(n, kinds[n], helps.get(n, ""), by_name.get(n, []))
+                for n in order]
+
+    def to_prometheus(self):
+        """Prometheus text exposition (format version 0.0.4). Golden-
+        guarded by tests/golden/metrics_exposition.txt — the format is a
+        scrape contract, changed only with the golden."""
+        lines = []
+        for name, kind, help, instruments in self._families():
+            if help:
+                lines.append("# HELP %s %s"
+                             % (name, help.replace("\n", " ")))
+            lines.append("# TYPE %s %s" % (name, kind))
+            for inst in instruments:
+                if kind == "histogram":
+                    count, total, cumulative = inst.state()
+                    for bound, c in zip(inst.buckets, cumulative):
+                        lines.append("%s_bucket%s %s" % (
+                            name,
+                            _labels_suffix(inst.labels, {"le": _fmt(bound)}),
+                            c))
+                    lines.append("%s_bucket%s %s" % (
+                        name, _labels_suffix(inst.labels, {"le": "+Inf"}),
+                        count))
+                    lines.append("%s_sum%s %s" % (
+                        name, _labels_suffix(inst.labels), _fmt(total)))
+                    lines.append("%s_count%s %s" % (
+                        name, _labels_suffix(inst.labels), count))
+                else:
+                    lines.append("%s%s %s" % (
+                        name, _labels_suffix(inst.labels),
+                        _fmt(inst.value)))
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self):
+        """JSON-able dict view: every series keyed by its full name
+        (labels rendered Prometheus-style), histograms with count/sum
+        and the exact percentile readout."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, kind, help, instruments in self._families():
+            for inst in instruments:
+                key = name + _labels_suffix(inst.labels)
+                if kind == "counter":
+                    out["counters"][key] = inst.value
+                elif kind == "gauge":
+                    out["gauges"][key] = inst.value
+                else:
+                    count, total, cumulative = inst.state()
+                    entry = {"count": count, "sum": round(total, 6),
+                             "buckets": {_fmt(b): c for b, c in
+                                         zip(inst.buckets, cumulative)}}
+                    entry.update({k: (round(v, 6) if v is not None
+                                      else None)
+                                  for k, v in inst.percentiles().items()})
+                    out["histograms"][key] = entry
+        return out
+
+    def reset(self):
+        """Drop every instrument (tests only — live instruments held by
+        callers keep working but detach from the exposition)."""
+        with self._lock:
+            self._metrics.clear()
+            self._kinds.clear()
+            self._helps.clear()
+            self._order = []
+
+
+_global_registry = MetricsRegistry()
+
+
+def get_registry():
+    """The process-global registry every subsystem shares (the serving
+    engine and trainer default to it; pass an explicit registry for
+    isolation in tests)."""
+    return _global_registry

@@ -33,6 +33,15 @@ Record types (field ``type``):
   ``rows`` (real rows), ``bucket`` (padded batch size), ``infer_ms``,
   optional ``batch``/``pad_rows``/``requests``/``queue_ms_max`` and the
   ``flush`` reason (``size``/``deadline``/``drain``).
+* ``anomaly`` — a sentinel trip (observe/sentinel.py): ``step``,
+  ``kind`` (``nan_inf_loss``/``loss_divergence``), optional ``cost``
+  (repr string when non-finite), ``threshold``, ``mode``, ``pass``.
+* ``crash_report`` — the flight-recorder black box, written on a
+  sentinel trip or an exception escaping the training loop: ``reason``
+  and ``steps`` (the ring of the last N step records, oldest first),
+  optional ``captured`` (lifetime records), ``capacity``, ``mode``,
+  ``anomaly``, ``artifact`` (the standalone JSON path),
+  ``suppressed_trips`` (repeat trips of an already-reported kind).
 * ``end``   — last line: total ``steps`` written.
 
 Unknown analysis code must ignore record types it does not know; within
@@ -265,6 +274,43 @@ class StepLog:
             rec["flush"] = str(flush)
         self.write(rec)
 
+    def log_anomaly(self, step, kind, cost=None, threshold=None,
+                    mode=None, pass_id=None):
+        """One sentinel trip (observe/sentinel.py)."""
+        rec = {"type": "anomaly", "step": int(step), "kind": str(kind),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if cost is not None:
+            rec["cost"] = cost if isinstance(cost, str) else float(cost)
+        if threshold is not None:
+            rec["threshold"] = round(float(threshold), 6)
+        if mode is not None:
+            rec["mode"] = str(mode)
+        if pass_id is not None:
+            rec["pass"] = int(pass_id)
+        self.write(rec)
+
+    def log_crash_report(self, reason, steps, captured=None,
+                         capacity=None, mode=None, anomaly=None,
+                         artifact=None, suppressed_trips=None):
+        """The flight-recorder black box: ``steps`` is the ring of the
+        last N step records, oldest first (observe/sentinel.py)."""
+        rec = {"type": "crash_report", "reason": str(reason),
+               "steps": list(steps),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if captured is not None:
+            rec["captured"] = int(captured)
+        if capacity is not None:
+            rec["capacity"] = int(capacity)
+        if mode is not None:
+            rec["mode"] = str(mode)
+        if anomaly is not None:
+            rec["anomaly"] = dict(anomaly)
+        if artifact is not None:
+            rec["artifact"] = str(artifact)
+        if suppressed_trips:
+            rec["suppressed_trips"] = int(suppressed_trips)
+        self.write(rec)
+
     def log_pass(self, pass_id, metrics=None):
         rec = {"type": "pass", "pass": int(pass_id),
                "t": round(time.perf_counter() - self._t0, 4)}
@@ -320,11 +366,19 @@ def summarize_dir(directory):
                "event_secs_total": round(sum(r.get("secs", 0.0)
                                              for r in events), 3)}
         if walls:
+            from paddle_tpu.observe.metrics import percentile
+
             run["wall_ms_mean"] = round(sum(walls) / len(walls), 3)
             run["wall_ms_min"] = round(min(walls), 3)
             # steady state excludes the first record (includes compile)
             tail = walls[1:] or walls
             run["wall_ms_steady_mean"] = round(sum(tail) / len(tail), 3)
+            # exact steady-state percentiles (same estimator as the
+            # metrics-registry histograms): a mean hides the stragglers
+            # a fleet pages on
+            for q, key in ((50, "wall_ms_p50"), (95, "wall_ms_p95"),
+                           (99, "wall_ms_p99")):
+                run[key] = round(percentile(tail, q), 3)
         ex = [r["examples_per_sec"] for r in steps
               if "examples_per_sec" in r]
         if ex:

@@ -19,6 +19,7 @@ fresh subprocess.
 
 import json
 import os
+import threading
 
 import numpy as np
 
@@ -83,6 +84,10 @@ class Bundle:
                                   self.manifest["params_file"])) as pz:
             self._params = {k: pz[k] for k in pz.files}
         self._executables = {}  # batch -> jax.export.Exported
+        # the engine's async-warmup thread and its batcher worker can
+        # both reach a cold bucket; the lock stops them deserializing
+        # and compiling the same artifact twice
+        self._exe_lock = threading.Lock()
 
     # -- bucket/shape machinery ---------------------------------------------
     def batch_sizes(self):
@@ -153,13 +158,18 @@ class Bundle:
         first call per bucket pays the deserialize+compile)."""
         exe = self._executables.get(batch)
         if exe is None:
-            from jax import export as jax_export
+            with self._exe_lock:
+                exe = self._executables.get(batch)
+                if exe is None:
+                    from jax import export as jax_export
 
-            bucket = next(b for b in self.buckets if b["batch"] == batch)
-            path = os.path.join(self.directory, bucket["artifact"])
-            with open(path, "rb") as fh:
-                exe = jax_export.deserialize(bytearray(fh.read()))
-            self._executables[batch] = exe
+                    bucket = next(b for b in self.buckets
+                                  if b["batch"] == batch)
+                    path = os.path.join(self.directory,
+                                        bucket["artifact"])
+                    with open(path, "rb") as fh:
+                        exe = jax_export.deserialize(bytearray(fh.read()))
+                    self._executables[batch] = exe
         return exe
 
     def warmup(self):

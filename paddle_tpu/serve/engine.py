@@ -18,7 +18,13 @@ executable, warmed at engine start so no request ever pays a compile.
 Observability: every batch runs inside a ``serve_batch`` span
 (paddle_tpu.observe) and — when telemetry is active or an explicit
 StepLog is passed — emits ``serve_batch``/``serve_request`` steplog
-records (schema v1, tests/golden/steplog_schema.json).
+records (schema v1, tests/golden/steplog_schema.json). Every hot-path
+event also updates the process-wide metrics registry
+(paddle_tpu.observe.metrics, ``paddle_tpu_serve_*`` series): request/
+row/batch/pad counters, flush-reason counters, queue-depth and
+in-flight gauges, per-bucket batch-fill and padding-waste ratios, and
+end-to-end latency histograms — scraped via ``GET /metrics`` on the
+HTTP front end (docs/observability.md).
 """
 
 import collections
@@ -28,6 +34,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.observe import spans as observe_spans
 from paddle_tpu.observe import steplog as observe_steplog
 from paddle_tpu.serve.bundle import flat_keys, pad_rows
@@ -56,7 +63,8 @@ class InferenceEngine:
     """
 
     def __init__(self, bundle, max_batch_size=None, max_latency_ms=5.0,
-                 steplog=None, warmup=True, run_name="serve"):
+                 steplog=None, warmup=True, run_name="serve",
+                 metrics_registry=None):
         self.bundle = bundle
         self.max_batch_size = int(max_batch_size or bundle.max_batch())
         if self.max_batch_size > bundle.max_batch():
@@ -70,22 +78,112 @@ class InferenceEngine:
         self._cv = threading.Condition()
         self._queue = collections.deque()
         self._queued_rows = 0
+        self._in_flight = 0  # accepted requests not yet resolved
         self._stopped = False
         self._req_counter = 0
         self._batch_counter = 0
         self._stats = collections.Counter()
+        self._per_bucket = {}  # bucket batch -> Counter(batches/rows/pad)
         self._owns_slog = steplog is None
         self._slog = (observe_steplog.from_env(run_name=run_name,
                                                meta={"phase": "serve"})
                       if steplog is None else steplog)
-        if warmup:
-            with observe_spans.span("serve_warmup",
-                                    args={"buckets":
-                                          len(bundle.buckets)}):
-                bundle.warmup()
+        self.metrics = metrics_registry or observe_metrics.get_registry()
+        self._build_metrics()
+        # readiness (k8s-style): the engine is READY once every exported
+        # bucket is warm — before that a request pays a compile, which a
+        # load balancer must not route traffic into. warmup=True warms
+        # synchronously (ready on return), "async" warms on a background
+        # thread (the HTTP front end can bind first and report
+        # ready=false until the warmup completes), False skips warmup
+        # (ready immediately — the operator opted into lazy compiles).
+        self._ready = threading.Event()
+        if warmup == "async":
+            def _bg_warmup():
+                try:
+                    self._warmup()
+                except Exception:  # noqa: BLE001 — logged in _warmup;
+                    pass           # the engine simply stays not-ready
+
+            threading.Thread(target=_bg_warmup,
+                             name="serve-warmup", daemon=True).start()
+        elif warmup:
+            self._warmup()
+        else:
+            self._ready.set()
+            self._m_ready.set(1)
         self._worker = threading.Thread(target=self._loop,
                                         name="serve-batcher", daemon=True)
         self._worker.start()
+
+    def _warmup(self):
+        try:
+            with observe_spans.span("serve_warmup",
+                                    args={"buckets":
+                                          len(self.bundle.buckets)}):
+                self.bundle.warmup()
+        except Exception:
+            # a failed warmup (corrupt artifact, compile OOM) must leave
+            # the probe NOT-ready — flipping ready here would route
+            # traffic into the very compiles readiness exists to fence.
+            # Sync callers (warmup=True) see the raise; the async thread
+            # logs it and the engine stays 503.
+            from paddle_tpu.utils.logger import logger
+
+            logger.exception("bucket warmup failed; engine stays "
+                             "not-ready")
+            raise
+        self._ready.set()
+        self._m_ready.set(1)
+
+    def ready(self):
+        """True once bucket warmup has completed (the readiness probe;
+        liveness is the worker thread being alive)."""
+        return self._ready.is_set()
+
+    def live(self):
+        return self._worker.is_alive() and not self._stopped
+
+    def _build_metrics(self):
+        m = self.metrics
+        self._m_requests = m.counter(
+            "paddle_tpu_serve_requests_total",
+            help="requests completed by the serving engine")
+        self._m_rows = m.counter(
+            "paddle_tpu_serve_rows_total",
+            help="real (unpadded) rows inferred")
+        self._m_batches = m.counter(
+            "paddle_tpu_serve_batches_total",
+            help="batches flushed to the device")
+        self._m_batches_failed = m.counter(
+            "paddle_tpu_serve_batches_failed_total",
+            help="batches whose forward raised")
+        self._m_pad_rows = m.counter(
+            "paddle_tpu_serve_pad_rows_total",
+            help="padding rows added to reach a bucket size")
+        self._m_flush = {
+            reason: m.counter("paddle_tpu_serve_flush_total",
+                              help="batch flushes by trigger",
+                              labels={"reason": reason})
+            for reason in ("size", "deadline", "drain")}
+        self._m_queue_depth = m.gauge(
+            "paddle_tpu_serve_queue_depth",
+            help="rows waiting for a batch flush")
+        self._m_in_flight = m.gauge(
+            "paddle_tpu_serve_in_flight",
+            help="accepted requests not yet resolved")
+        self._m_ready = m.gauge(
+            "paddle_tpu_serve_ready",
+            help="1 once every exported bucket is warm")
+        self._m_latency = m.histogram(
+            "paddle_tpu_serve_request_latency_ms",
+            help="end-to-end request latency (enqueue to result)")
+        self._m_queue_ms = m.histogram(
+            "paddle_tpu_serve_request_queue_ms",
+            help="time a request waited for its batch flush")
+        self._m_infer_ms = m.histogram(
+            "paddle_tpu_serve_batch_infer_ms",
+            help="device forward time per flushed batch")
 
     # -- client surface -----------------------------------------------------
     def submit(self, inputs):
@@ -113,6 +211,9 @@ class InferenceEngine:
             req = _Request(inputs, rows, self._req_counter)
             self._queue.append(req)
             self._queued_rows += rows
+            self._in_flight += 1
+            self._m_queue_depth.set(self._queued_rows)
+            self._m_in_flight.set(self._in_flight)
             self._cv.notify_all()
         return req.future
 
@@ -120,14 +221,23 @@ class InferenceEngine:
         return self.submit(inputs).result(timeout=timeout)
 
     def stats(self):
+        """Engine counters plus live load state, snapshotted atomically
+        under the engine lock: ``queue_depth`` (rows waiting for a batch
+        flush) and ``in_flight`` (accepted requests not yet resolved)
+        distinguish a draining queue from a stuck one — the cumulative
+        counters alone cannot."""
         with self._cv:
             out = dict(self._stats)
             for key in ("batches", "requests", "rows", "pad_rows",
                         "flush_on_size", "flush_on_deadline"):
                 out.setdefault(key, 0)
-            out["queued_rows"] = self._queued_rows
+            out["queue_depth"] = self._queued_rows
+            out["queued_rows"] = self._queued_rows  # back-compat alias
+            out["in_flight"] = self._in_flight
             out["max_batch_size"] = self.max_batch_size
             out["max_latency_ms"] = self.max_latency_ms
+        out["ready"] = self.ready()
+        out["latency_ms"] = self._m_latency.percentiles()
         return out
 
     def stop(self, timeout=30.0):
@@ -174,6 +284,7 @@ class InferenceEngine:
                 batch.append(req)
                 rows += req.rows
             self._queued_rows -= rows
+            self._m_queue_depth.set(self._queued_rows)
             return batch, rows, reason
 
     def _loop(self):
@@ -190,6 +301,9 @@ class InferenceEngine:
                         req.future.set_exception(exc)
                 with self._cv:
                     self._stats["batches_failed"] += 1
+                    self._in_flight -= len(requests)
+                    self._m_in_flight.set(self._in_flight)
+                self._m_batches_failed.inc()
 
     def _run_batch(self, requests, rows, reason):
         t_start = time.perf_counter()
@@ -215,12 +329,14 @@ class InferenceEngine:
             result = {k: v[offset:offset + req.rows]
                       for k, v in out.items()}
             offset += req.rows
+            queue_ms = (t_start - req.t_enqueue) * 1e3
+            latency_ms = (t_done - req.t_enqueue) * 1e3
             if self._slog is not None:
                 self._slog.log_serve_request(
-                    rows=req.rows,
-                    queue_ms=(t_start - req.t_enqueue) * 1e3,
-                    latency_ms=(t_done - req.t_enqueue) * 1e3,
-                    req_id=req.req_id)
+                    rows=req.rows, queue_ms=queue_ms,
+                    latency_ms=latency_ms, req_id=req.req_id)
+            self._m_queue_ms.observe(queue_ms)
+            self._m_latency.observe(latency_ms)
             req.future.set_result(result)
         if self._slog is not None:
             self._slog.log_serve_batch(
@@ -228,9 +344,34 @@ class InferenceEngine:
                 batch_id=batch_id, pad_rows=bucket["batch"] - rows,
                 requests=len(requests), queue_ms_max=queue_ms_max,
                 flush=reason)
+        pad = bucket["batch"] - rows
         with self._cv:
             self._stats["batches"] += 1
             self._stats["requests"] += len(requests)
             self._stats["rows"] += rows
-            self._stats["pad_rows"] += bucket["batch"] - rows
+            self._stats["pad_rows"] += pad
             self._stats["flush_on_" + reason] += 1
+            self._in_flight -= len(requests)
+            self._m_in_flight.set(self._in_flight)
+            pb = self._per_bucket.setdefault(
+                bucket["batch"], collections.Counter())
+            pb["batches"] += 1
+            pb["rows"] += rows
+            pb["pad"] += pad
+            fill, waste = pb["rows"], pb["pad"]
+        self._m_requests.inc(len(requests))
+        self._m_rows.inc(rows)
+        self._m_batches.inc()
+        self._m_pad_rows.inc(pad)
+        self._m_flush[reason].inc()
+        self._m_infer_ms.observe(infer_ms)
+        # cumulative per-bucket occupancy: fill + waste sum to 1.0 — the
+        # capacity split between real rows and padding for this bucket
+        slots = fill + waste
+        blabel = {"bucket": str(bucket["batch"])}
+        self.metrics.gauge("paddle_tpu_serve_batch_fill_ratio",
+                           help="real rows / bucket slots (cumulative)",
+                           labels=blabel).set(fill / slots)
+        self.metrics.gauge("paddle_tpu_serve_padding_waste_ratio",
+                           help="padding rows / bucket slots (cumulative)",
+                           labels=blabel).set(waste / slots)

@@ -6,9 +6,18 @@ engine behind three endpoints:
 * ``POST /infer``   — body ``{"inputs": {flat_key: nested_lists}}``;
   responds ``{"outputs": {name: nested_lists}}``. Dtypes come from the
   bundle manifest, so clients send plain JSON numbers.
-* ``GET /healthz``  — ``{"ok": true, "bundle": <name>}`` once the
-  engine is warmed (a liveness/readiness probe).
-* ``GET /stats``    — engine counters (batches, rows, flush reasons).
+* ``GET /healthz``  — ``{"ok": <ready>, "live": ..., "ready": ...,
+  "bundle": <name>}``. **Liveness** (the batcher thread is running) and
+  **readiness** (every exported bucket is warm — before that a request
+  pays a compile, so a balancer must not route here yet) are distinct:
+  status 200 when ready, 503 while live-but-warming. ``/livez`` and
+  ``/readyz`` expose each probe alone, k8s-style.
+* ``GET /metrics``  — Prometheus text exposition of the process-wide
+  registry (paddle_tpu.observe.metrics): request/row/batch counters,
+  queue-depth/in-flight gauges, latency histograms, per-bucket fill and
+  padding-waste ratios (docs/observability.md).
+* ``GET /stats``    — engine counters + live ``queue_depth``/
+  ``in_flight`` + exact latency percentiles, as JSON.
 * ``GET /manifest`` — the bundle manifest (model discovery, TF-Serving
   GetModelMetadata analogue).
 
@@ -51,9 +60,12 @@ class _Handler(BaseHTTPRequestHandler):
     bundle = None
 
     def _send(self, code, obj):
-        body = json.dumps(obj).encode()
+        self._send_text(code, json.dumps(obj), "application/json")
+
+    def _send_text(self, code, text, content_type):
+        body = text.encode()
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -65,7 +77,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            self._send(200, {"ok": True, "bundle": self.bundle.name})
+            live, ready = self.engine.live(), self.engine.ready()
+            self._send(200 if (live and ready) else 503,
+                       {"ok": live and ready, "live": live,
+                        "ready": ready, "bundle": self.bundle.name})
+        elif self.path == "/livez":
+            live = self.engine.live()
+            self._send(200 if live else 503, {"live": live})
+        elif self.path == "/readyz":
+            ready = self.engine.ready()
+            self._send(200 if ready else 503, {"ready": ready})
+        elif self.path == "/metrics":
+            # Prometheus text exposition, format version 0.0.4
+            self._send_text(
+                200, self.engine.metrics.to_prometheus(),
+                "text/plain; version=0.0.4; charset=utf-8")
         elif self.path == "/stats":
             self._send(200, self.engine.stats())
         elif self.path == "/manifest":

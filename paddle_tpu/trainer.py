@@ -32,6 +32,8 @@ from paddle_tpu.utils.error import enforce
 from paddle_tpu.utils.logger import logger
 
 
+from paddle_tpu.observe import metrics as observe_metrics
+from paddle_tpu.observe import sentinel as observe_sentinel
 from paddle_tpu.observe import spans as observe_spans
 from paddle_tpu.observe import steplog as observe_steplog
 from paddle_tpu.utils.stat import global_stats
@@ -215,6 +217,11 @@ class SGD:
             # after, so later non-telemetry runs don't keep buffering)
             tracer.record_events = True
             tracer.reset()  # the exported trace covers exactly this run
+        # the in-flight loss sentinel + flight recorder (observe/
+        # sentinel.py): cheap host checks on the already-read-back cost,
+        # PADDLE_TPU_SENTINEL governs warn/halt/off; the crash artifact
+        # lands next to the steplog when telemetry is on
+        sentinel = observe_sentinel.from_env(steplog=slog)
         # first step's wall interval is anchored at train start, so the
         # first record honestly includes compile time (the compile shows
         # up as an ``event`` record too when jax.monitoring emits it)
@@ -222,7 +229,13 @@ class SGD:
         try:
             self._train_passes(reader, num_passes, event_handler, feeding,
                                sync_params, test_reader, log_period,
-                               test_period, slog, last_final)
+                               test_period, slog, last_final, sentinel)
+        except BaseException as exc:
+            # any escape from the training loop dumps the black box
+            # (a sentinel halt already dumped; on_exception skips it)
+            if sentinel is not None:
+                sentinel.on_exception(exc)
+            raise
         finally:
             if slog is not None:
                 try:
@@ -231,9 +244,25 @@ class SGD:
                     tracer.record_events = prev_recording
                     slog.close()
 
+    # process-wide training metrics (observe/metrics.py; scraped through
+    # any serve front end in the same process, snapshot()-able anywhere)
+    @staticmethod
+    def _train_metrics():
+        m = observe_metrics.get_registry()
+        return (m.counter("paddle_tpu_train_steps_total",
+                          help="finalized training steps"),
+                m.counter("paddle_tpu_train_examples_total",
+                          help="examples consumed by training steps"),
+                m.gauge("paddle_tpu_train_loss",
+                        help="last finalized step loss"),
+                m.gauge("paddle_tpu_train_examples_per_sec",
+                        help="examples/s of the last finalized step"))
+
     def _train_passes(self, reader, num_passes, event_handler, feeding,
                       sync_params, test_reader, log_period, test_period,
-                      slog, last_final):
+                      slog, last_final, sentinel=None):
+        (m_steps, m_examples, m_loss,
+         m_examples_per_sec) = self._train_metrics()
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             eval_acc = {e.name: None for e in self.evaluators}
@@ -257,14 +286,25 @@ class SGD:
                             eval_acc[e.name], jax.device_get(stats[e.name]))
                         metrics[e.name] = e.result(eval_acc[e.name])
                     loss = float(loss)
+                now = time.perf_counter()
+                wall_ms = (now - last_final["t"]) * 1000.0
+                last_final["t"] = now
                 if slog is not None:
-                    now = time.perf_counter()
-                    wall_ms = (now - last_final["t"]) * 1000.0
-                    last_final["t"] = now
                     slog.log_step(
                         step=self._pending_step_of(b_id), pass_id=pass_id,
                         batch_id=b_id, wall_ms=wall_ms, feed_ms=feed_ms,
                         cost=loss, examples=n_examples, metrics=metrics)
+                m_steps.inc()
+                m_examples.inc(n_examples)
+                m_loss.set(loss)
+                if wall_ms > 0:
+                    m_examples_per_sec.set(n_examples / wall_ms * 1000.0)
+                if sentinel is not None:
+                    # halt mode raises TrainingAnomaly here (black box
+                    # already dumped by the sentinel itself)
+                    sentinel.step(self._pending_step_of(b_id), cost=loss,
+                                  pass_id=pass_id, batch_id=b_id,
+                                  wall_ms=round(wall_ms, 4))
                 # reference per-batch sequence: forwardBackward done →
                 # EndForwardBackward → stats/periodic-test → EndIteration
                 # (TrainerInternal.cpp:66-140). With the one-deep pipeline

@@ -503,7 +503,7 @@ def test_summarize_dir_and_cli_observe(tmp_path, capsys):
 
     assert cli.main(["observe", str(tmp_path)]) in (0, None)
     out = capsys.readouterr().out
-    assert "unit" in out and "steady mean" in out
+    assert "unit" in out and "steady p50" in out
     assert cli.main(["observe", str(tmp_path), "--json"]) in (0, None)
     parsed = json.loads(capsys.readouterr().out)
     assert parsed["runs"][0]["steps"] == 2

@@ -1,0 +1,297 @@
+"""paddle_tpu.observe.regress tests — the spread-aware bench regression
+gate. Acceptance: a ≥20%-worse synthetic row against the checked-in
+BENCH_r05.json audited tail is flagged, an equal-or-better row passes,
+and the spread widening is unit-tested on both sides. Also covers the
+bench.py wiring (warn-only default, PADDLE_TPU_BENCH_GATE=hard fails
+the run) and ``cli observe --regress`` exiting non-zero.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from paddle_tpu.observe import regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_R05 = os.path.join(REPO, "BENCH_r05.json")
+
+
+# -- direction / audited parsing ---------------------------------------------
+
+def test_direction_from_unit_and_metric():
+    assert regress.direction({"unit": "ms/batch"}) == -1
+    assert regress.direction({"unit": "samples/s"}) == 1
+    assert regress.direction({"unit": "qps"}) == 1
+    assert regress.direction(
+        {"metric": "x_train_samples_per_sec_bs64"}) == 1
+    assert regress.direction({"metric": "x_train_ms_per_batch_bs1"}) == -1
+    assert regress.direction({"metric": "mystery", "unit": "widgets"}) \
+        is None
+
+
+def test_audited_rows_parse_the_driver_record_shape():
+    """BENCH_*.json is the driver shape: {"tail": "<json lines>",
+    "parsed": {...}} — every tail line must contribute."""
+    rows = list(regress.iter_audited_rows([BENCH_R05]))
+    metrics = {r["metric"] for r in rows}
+    assert "alexnet_train_ms_per_batch_bs128" in metrics
+    assert "resnet50_train_samples_per_sec_per_chip_bs64" in metrics
+    assert all(r["_source"] == "BENCH_r05.json" for r in rows)
+
+
+def test_best_audited_is_direction_aware(tmp_path):
+    a = tmp_path / "BENCH_a.json"
+    a.write_text(json.dumps({"tail": "\n".join([
+        json.dumps({"metric": "m_ms", "value": 10.0, "unit": "ms/batch"}),
+        json.dumps({"metric": "m_ms", "value": 8.0, "unit": "ms/batch"}),
+        json.dumps({"metric": "m_sps", "value": 100.0,
+                    "unit": "samples/s"}),
+        json.dumps({"metric": "m_sps", "value": 140.0,
+                    "unit": "samples/s"}),
+        "not json {",  # kill-tail truncation must not sink the parse
+    ])}))
+    best = regress.best_audited([str(a)])
+    assert best["m_ms"]["value"] == 8.0      # lower is better
+    assert best["m_sps"]["value"] == 140.0   # higher is better
+
+
+def test_baseline_published_map_parses_despite_top_level_metric(tmp_path):
+    """BASELINE.json's top level has a descriptive "metric" STRING next
+    to the published map — the published entries must still contribute
+    (regression guard: the bare-row branch used to early-return)."""
+    b = tmp_path / "BASELINE.json"
+    b.write_text(json.dumps({
+        "metric": "samples/sec/chip (ResNet-50 ImageNet) + ...",
+        "north_star": "prose",
+        "published": {
+            "resnet50_train_samples_per_sec_per_chip_bs64": 2000.0}}))
+    best = regress.best_audited([str(b)])
+    assert best["resnet50_train_samples_per_sec_per_chip_bs64"][
+        "value"] == 2000.0
+
+
+def test_default_audit_paths_find_the_checked_in_set():
+    paths = regress.default_audit_paths(REPO)
+    names = [os.path.basename(p) for p in paths]
+    assert "BENCH_r05.json" in names and "BASELINE.json" in names
+
+
+# -- the gate (acceptance: vs the real BENCH_r05 tail) -----------------------
+
+@pytest.fixture(scope="module")
+def r05_best():
+    return regress.best_audited([BENCH_R05])
+
+
+def test_twenty_pct_worse_row_is_flagged(r05_best):
+    """A >=20%-worse synthetic row against the audited r05 tail gates
+    (base tolerance 10%, low spread)."""
+    best = r05_best["alexnet_train_ms_per_batch_bs128"]["value"]
+    row = {"metric": "alexnet_train_ms_per_batch_bs128",
+           "value": round(best * 1.20, 3), "unit": "ms/batch",
+           "spread_pct": 5.0}
+    result = regress.check_row(row, r05_best)
+    assert result["status"] == "regression"
+    assert result["worse_pct"] == pytest.approx(20.0, abs=0.1)
+    assert result["tol_pct"] == pytest.approx(15.0)
+    assert result["best_source"] == "BENCH_r05.json"
+
+
+def test_equal_and_better_rows_pass(r05_best):
+    best = r05_best["resnet50_train_samples_per_sec_per_chip_bs64"]
+    for value in (best["value"], best["value"] * 1.1):
+        row = {"metric": "resnet50_train_samples_per_sec_per_chip_bs64",
+               "value": value, "unit": "samples/s", "spread_pct": 4.0}
+        assert regress.check_row(row, r05_best)["status"] == "ok"
+
+
+def test_spread_widens_tolerance_on_both_sides(r05_best):
+    """The SAME 20%-worse value gates at spread 2% and passes at spread
+    15% — the row's own error bar is the widening."""
+    best = r05_best["googlenet_train_ms_per_batch_bs128"]["value"]
+    row = {"metric": "googlenet_train_ms_per_batch_bs128",
+           "value": round(best * 1.20, 3), "unit": "ms/batch"}
+    tight = regress.check_row(dict(row, spread_pct=2.0), r05_best)
+    loose = regress.check_row(dict(row, spread_pct=15.0), r05_best)
+    assert tight["status"] == "regression"
+    assert tight["tol_pct"] == pytest.approx(12.0)
+    assert loose["status"] == "ok"
+    assert loose["tol_pct"] == pytest.approx(25.0)
+
+
+def test_demoted_spread_caps_the_widening(r05_best):
+    """A row whose spread was demoted (>100% -> spread_raw_pct) widens
+    by the 100% cap: only catastrophic regressions gate."""
+    best = r05_best["alexnet_train_ms_per_batch_bs128"]["value"]
+    row = {"metric": "alexnet_train_ms_per_batch_bs128",
+           "unit": "ms/batch", "spread_pct": None,
+           "spread_raw_pct": 15689.0}
+    ok = regress.check_row(dict(row, value=best * 2.0), r05_best)
+    assert ok["status"] == "ok" and ok["tol_pct"] == pytest.approx(110.0)
+    bad = regress.check_row(dict(row, value=best * 2.2), r05_best)
+    assert bad["status"] == "regression"
+
+
+def test_unknown_metric_and_value_statuses(r05_best):
+    assert regress.check_row({"metric": "brand_new", "value": 1.0,
+                              "unit": "ms/batch"},
+                             r05_best)["status"] == "no_baseline"
+    assert regress.check_row({"metric": "alexnet_train_ms_per_batch_bs128",
+                              "value": None, "unit": "ms/batch"},
+                             r05_best)["status"] == "no_value"
+    assert regress.check_row({"metric": "bench_killed", "value": 15,
+                              "unit": "signal"},
+                             r05_best)["status"] == "ungated"
+
+
+def test_check_row_applies_field_invariants(r05_best):
+    """sanitize_bench_row stays the first line of defense: a broken
+    serving row is REJECTED by the gate exactly as at emission time."""
+    with pytest.raises(ValueError, match="p99_ms"):
+        regress.check_row({"metric": "serve_mlp_qps_c8", "value": 100.0,
+                           "unit": "qps", "p50_ms": 9.0, "p99_ms": 1.0},
+                          r05_best)
+
+
+def test_gate_rows_defaults_to_repo_audited_set():
+    rows = [{"metric": "alexnet_train_ms_per_batch_bs128", "value": 50.0,
+             "unit": "ms/batch", "spread_pct": 1.0},
+            {"metric": "alexnet_train_ms_per_batch_bs128", "value": 9.0,
+             "unit": "ms/batch", "spread_pct": 1.0}]
+    results, regressions = regress.gate_rows(rows, repo_root=REPO)
+    assert len(results) == 2 and len(regressions) == 1
+    assert regressions[0]["value"] == 50.0
+
+
+# -- bench.py wiring ---------------------------------------------------------
+
+def _bench():
+    sys.path.insert(0, REPO)
+    import bench
+
+    return bench
+
+
+@pytest.fixture
+def clean_bench():
+    bench = _bench()
+    saved = (dict(bench._EMITTED), list(bench._EMIT_ORDER),
+             list(bench._GATE_FAILURES))
+    bench._GATE_FAILURES.clear()
+    yield bench
+    bench._EMITTED.clear()
+    bench._EMITTED.update(saved[0])
+    bench._EMIT_ORDER[:] = saved[1]
+    bench._GATE_FAILURES[:] = saved[2]
+
+
+def test_bench_print_warns_on_regressed_row(clean_bench, capsys,
+                                            monkeypatch):
+    """Warn-only default: the synthetic regressed row annotates + warns
+    but the run does not fail."""
+    monkeypatch.delenv(regress.GATE_ENV, raising=False)
+    bench = clean_bench
+    bench._print({"metric": "alexnet_train_ms_per_batch_bs128",
+                  "value": 50.0, "unit": "ms/batch", "spread_pct": 2.0})
+    out, err = capsys.readouterr()
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert "REGRESSION" in rec["regress_note"]
+    assert "REGRESSION" in err
+    assert len(bench._GATE_FAILURES) == 1
+    bench._gate_exit()  # warn mode: no SystemExit
+
+
+def test_bench_gate_hard_mode_fails_the_run(clean_bench, capsys,
+                                            monkeypatch):
+    monkeypatch.setenv(regress.GATE_ENV, "hard")
+    bench = clean_bench
+    bench._print({"metric": "alexnet_train_ms_per_batch_bs128",
+                  "value": 50.0, "unit": "ms/batch", "spread_pct": 2.0})
+    bench._gate_summary()
+    with pytest.raises(SystemExit) as exc_info:
+        bench._gate_exit()
+    assert exc_info.value.code == 3
+    out = capsys.readouterr().out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["metric"] == "bench_regression_gate"
+    assert summary["mode"] == "hard"
+    assert summary["gated"] == ["alexnet_train_ms_per_batch_bs128"]
+
+
+def test_bench_good_row_passes_quietly(clean_bench, capsys, monkeypatch):
+    monkeypatch.setenv(regress.GATE_ENV, "hard")
+    bench = clean_bench
+    bench._print({"metric": "alexnet_train_ms_per_batch_bs128",
+                  "value": 9.2, "unit": "ms/batch", "spread_pct": 2.0})
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "regress_note" not in rec
+    assert bench._GATE_FAILURES == []
+    bench._gate_exit()  # nothing gated: no exit even in hard mode
+
+
+# -- cli observe --regress ---------------------------------------------------
+
+def test_cli_observe_regress_exits_nonzero_on_regression(tmp_path,
+                                                         capsys):
+    from paddle_tpu import cli
+    from paddle_tpu.observe import steplog
+
+    with steplog.StepLog(str(tmp_path), run_name="bench",
+                         compile_events=False) as slog:
+        slog.write({"type": "bench_row",
+                    "metric": "alexnet_train_ms_per_batch_bs128",
+                    "value": 50.0, "unit": "ms/batch", "spread_pct": 2.0})
+        slog.write({"type": "bench_row",
+                    "metric": "googlenet_train_ms_per_batch_bs128",
+                    "value": 20.0, "unit": "ms/batch", "spread_pct": 2.0})
+    rc = cli.main(["observe", str(tmp_path), "--regress", BENCH_R05])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "2 row(s) checked, 1 gated" in out
+    assert "REGRESSION alexnet_train_ms_per_batch_bs128" in out
+
+    # --json carries the same verdicts machine-readably
+    rc = cli.main(["observe", str(tmp_path), "--regress", BENCH_R05,
+                   "--json"])
+    assert rc == 1
+    parsed = json.loads(capsys.readouterr().out)
+    statuses = {r["metric"]: r["status"] for r in parsed["regress"]}
+    assert statuses["alexnet_train_ms_per_batch_bs128"] == "regression"
+    assert statuses["googlenet_train_ms_per_batch_bs128"] == "ok"
+
+
+def test_cli_observe_regress_all_ok_exits_zero(tmp_path, capsys):
+    from paddle_tpu import cli
+    from paddle_tpu.observe import steplog
+
+    with steplog.StepLog(str(tmp_path), run_name="bench",
+                         compile_events=False) as slog:
+        slog.write({"type": "bench_row",
+                    "metric": "alexnet_train_ms_per_batch_bs128",
+                    "value": 9.2, "unit": "ms/batch", "spread_pct": 2.0})
+    rc = cli.main(["observe", str(tmp_path), "--regress", BENCH_R05])
+    assert rc == 0
+    assert "1 row(s) checked, 0 gated" in capsys.readouterr().out
+
+
+def test_cli_observe_prints_steady_state_percentiles(tmp_path, capsys):
+    from paddle_tpu import cli
+    from paddle_tpu.observe import steplog
+
+    with steplog.StepLog(str(tmp_path), run_name="train",
+                         compile_events=False) as slog:
+        for i, wall in enumerate([500.0, 3.0, 4.0, 5.0, 6.0, 100.0]):
+            slog.log_step(step=i + 1, wall_ms=wall)
+    rc = cli.main(["observe", str(tmp_path), "--json"])
+    assert rc == 0
+    run = json.loads(capsys.readouterr().out)["runs"][0]
+    # steady state excludes the first (compile) record
+    assert run["wall_ms_p50"] == pytest.approx(5.0)
+    assert run["wall_ms_p95"] == 81.2
+    assert run["wall_ms_p99"] == 96.24
+    rc = cli.main(["observe", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "p50" in out and "p95" in out and "p99" in out

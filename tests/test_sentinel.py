@@ -1,0 +1,306 @@
+"""paddle_tpu.observe.sentinel tests — flight recorder ring, NaN/Inf +
+divergence checks, warn/halt modes, and the ISSUE acceptance smoke: an
+Inf loss injected into a 3-step dense CPU train trips the sentinel,
+``PADDLE_TPU_SENTINEL=halt`` raises with a schema-valid ``crash_report``
+record containing the last-N step ring, and the default warn mode
+completes the run with an ``anomaly`` record.
+"""
+
+import glob
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observe import sentinel, steplog
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "steplog_schema.json")
+
+
+def _schema_check(rec):
+    spec = json.load(open(GOLDEN))["record_types"][rec["type"]]
+    keys = set(rec)
+    assert set(spec["required"]) <= keys, (rec["type"], rec)
+    assert not keys - set(spec["required"]) - set(spec["optional"]), rec
+
+
+# -- modes -------------------------------------------------------------------
+
+def test_sentinel_mode_env(monkeypatch):
+    monkeypatch.delenv(sentinel.SENTINEL_ENV, raising=False)
+    assert sentinel.sentinel_mode() == "warn"  # cheap checks: on by default
+    monkeypatch.setenv(sentinel.SENTINEL_ENV, "halt")
+    assert sentinel.sentinel_mode() == "halt"
+    monkeypatch.setenv(sentinel.SENTINEL_ENV, "off")
+    assert sentinel.sentinel_mode() == "off"
+    assert sentinel.from_env() is None  # disabled -> no sentinel at all
+    monkeypatch.setenv(sentinel.SENTINEL_ENV, "warn")
+    assert sentinel.from_env().mode == "warn"
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flight_recorder_ring_keeps_last_n():
+    rec = sentinel.FlightRecorder(capacity=3)
+    for i in range(7):
+        rec.record({"step": i, "cost": float(i)})
+    assert len(rec) == 3
+    steps = [r["step"] for r in rec.records()]
+    assert steps == [4, 5, 6]  # oldest first, last N only
+    body = rec.crash_report("unit")
+    assert body["captured"] == 7 and body["capacity"] == 3
+    assert [s["step"] for s in body["steps"]] == [4, 5, 6]
+
+
+def test_flight_recorder_dump_artifact_and_record(tmp_path):
+    rec = sentinel.FlightRecorder(capacity=4)
+    rec.record({"step": 1, "cost": 0.5})
+    with steplog.StepLog(str(tmp_path), run_name="unit",
+                         compile_events=False) as slog:
+        path = rec.dump(str(tmp_path), run_name="unit", reason="r1",
+                        steplog=slog)
+        path2 = rec.dump(str(tmp_path), run_name="unit", reason="r2",
+                         steplog=slog)
+    assert os.path.basename(path) == "unit.crash.json"
+    assert os.path.basename(path2) == "unit.crash-2.json"  # no clobber
+    artifact = json.load(open(path))
+    assert artifact["format"] == sentinel.ARTIFACT_FORMAT
+    assert artifact["reason"] == "r1"
+    assert [s["step"] for s in artifact["steps"]] == [1]
+    records = steplog.read_jsonl(slog.path)
+    crashes = [r for r in records if r["type"] == "crash_report"]
+    assert len(crashes) == 2
+    for c in crashes:
+        _schema_check(c)
+    assert crashes[0]["artifact"] == path
+
+
+def test_flight_recorder_dump_without_directory():
+    rec = sentinel.FlightRecorder()
+    rec.record({"step": 1})
+    assert rec.dump(None, reason="x") is None  # no dir -> no artifact
+
+
+# -- checks ------------------------------------------------------------------
+
+def test_nan_and_inf_loss_trip():
+    for bad in (float("nan"), float("inf"), float("-inf")):
+        s = sentinel.Sentinel(mode="warn")
+        s.step(1, cost=0.5)
+        anomaly = s.step(2, cost=bad)
+        assert anomaly["kind"] == "nan_inf_loss"
+        assert isinstance(anomaly["cost"], str)  # JSON-safe repr
+
+
+def test_divergence_trips_after_warmup_only():
+    s = sentinel.Sentinel(mode="warn", warmup_steps=4,
+                          divergence_factor=10.0)
+    # a huge early loss is NOT divergence (fresh model, check unarmed)
+    assert s.step(1, cost=1000.0) is None
+    for i in range(2, 6):
+        assert s.step(i, cost=1.0) is None
+    scale_before = s._loss_scale
+    anomaly = s.step(6, cost=1e5)
+    assert anomaly["kind"] == "loss_divergence"
+    assert anomaly["threshold"] > 0
+    # the diverged loss must NOT have dragged the baseline up after it
+    assert s._loss_scale == scale_before
+
+
+def test_warn_mode_emits_and_dumps_once_per_kind(tmp_path):
+    """A persistently-NaN run in warn mode must not write one crash
+    artifact per step: the first trip of a kind emits + dumps, repeats
+    are counted as suppressed_trips."""
+    s = sentinel.Sentinel(mode="warn", artifact_dir=str(tmp_path),
+                          run_name="flood")
+    s.step(1, cost=0.5)
+    assert s.step(2, cost=float("nan"))["kind"] == "nan_inf_loss"
+    for i in range(3, 50):
+        assert s.step(i, cost=float("nan")) is None  # suppressed
+    assert len(s.anomalies) == 1
+    assert s._suppressed == 47
+    assert len(glob.glob(str(tmp_path / "flood.crash*.json"))) == 1
+    # a later exception dump records how many trips were suppressed
+    path = s.on_exception(RuntimeError("late"))
+    assert json.load(open(path))["suppressed_trips"] == 47
+
+
+def test_dump_failure_never_replaces_the_run(tmp_path, monkeypatch):
+    """An unwritable artifact dir (full disk) must not turn a sentinel
+    trip or an exception dump into an OSError."""
+    s = sentinel.Sentinel(mode="warn",
+                          artifact_dir="/proc/definitely/unwritable")
+    assert s.step(1, cost=float("inf"))["kind"] == "nan_inf_loss"
+    assert s.on_exception(RuntimeError("x")) is None
+    assert s.artifacts == []
+
+
+def test_normal_training_never_trips():
+    s = sentinel.Sentinel(mode="halt", warmup_steps=2,
+                          divergence_factor=50.0)
+    rng = np.random.RandomState(0)
+    for i in range(100):
+        assert s.step(i, cost=1.0 + 0.3 * rng.randn()) is None
+    assert s.anomalies == []
+
+
+def test_halt_mode_raises_and_dumps(tmp_path):
+    s = sentinel.Sentinel(mode="halt", artifact_dir=str(tmp_path),
+                          run_name="halted")
+    s.step(1, cost=0.5)
+    with pytest.raises(sentinel.TrainingAnomaly) as exc_info:
+        s.step(2, cost=float("inf"))
+    assert exc_info.value.anomaly["kind"] == "nan_inf_loss"
+    assert getattr(exc_info.value, "_black_box_dumped") is True
+    artifacts = glob.glob(str(tmp_path / "halted.crash*.json"))
+    assert len(artifacts) == 1
+    body = json.load(open(artifacts[0]))
+    assert [r["step"] for r in body["steps"]] == [1, 2]
+    # on_exception must not double-dump an already-dumped halt
+    assert s.on_exception(exc_info.value) is None
+    assert len(glob.glob(str(tmp_path / "halted.crash*.json"))) == 1
+
+
+def test_on_exception_dumps_black_box(tmp_path):
+    s = sentinel.Sentinel(mode="warn", artifact_dir=str(tmp_path),
+                          run_name="crashed")
+    s.step(1, cost=0.5)
+    path = s.on_exception(RuntimeError("boom"))
+    body = json.load(open(path))
+    assert "boom" in body["reason"]
+
+
+def test_off_mode_records_but_never_checks():
+    s = sentinel.Sentinel(mode="off")
+    assert s.step(1, cost=float("nan")) is None
+    assert s.anomalies == []
+    assert len(s.recorder) == 1  # the ring still fills (free black box)
+
+
+# -- trainer integration (the ISSUE acceptance smoke) ------------------------
+
+def _poisoned_train(tmp_path, monkeypatch, mode):
+    """3-step dense CPU train whose loss goes Inf at step 2: an
+    EndIteration handler multiplies a weight by inf, so the NEXT step's
+    readback cost is non-finite."""
+    import paddle_tpu as paddle
+    import paddle_tpu.event as ev
+    from paddle_tpu import activation as A
+    from paddle_tpu import data_type as dt
+    from paddle_tpu import layer as L
+    from paddle_tpu import minibatch
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.parameters import Parameters
+
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY", str(tmp_path))
+    if mode is None:
+        monkeypatch.delenv(sentinel.SENTINEL_ENV, raising=False)
+    else:
+        monkeypatch.setenv(sentinel.SENTINEL_ENV, mode)
+
+    x = L.data(name="x", type=dt.dense_vector(6))
+    lab = L.data(name="y", type=dt.integer_value(3))
+    out = L.fc(input=L.fc(input=x, size=12, act=A.Tanh()), size=3)
+    cost = L.classification_cost(input=out, label=lab)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost, params, opt.Momentum(momentum=0.9, learning_rate=0.1))
+
+    def reader():
+        rng = np.random.RandomState(7)
+        for _ in range(24):
+            xv = rng.randn(6).astype(np.float32)
+            yield xv, int(abs(xv[0] * 3) % 3)
+
+    def handler(event):
+        if isinstance(event, ev.EndIteration) and event.batch_id == 0:
+            import jax.numpy as jnp
+
+            name = next(iter(trainer._trainable))
+            trainer._trainable[name] = trainer._trainable[name] * jnp.inf
+
+    def run():
+        trainer.train(minibatch.batch(reader, 8), num_passes=1,
+                      event_handler=handler)
+
+    return run
+
+
+def _records(tmp_path):
+    paths = sorted(glob.glob(str(tmp_path / "train*.steps.jsonl")))
+    assert paths
+    return steplog.read_jsonl(paths[0])
+
+
+def test_inf_loss_warn_mode_completes_with_anomaly_record(
+        tmp_path, monkeypatch):
+    run = _poisoned_train(tmp_path, monkeypatch, mode=None)  # default
+    run()  # warn: the run completes
+    records = _records(tmp_path)
+    anomalies = [r for r in records if r["type"] == "anomaly"]
+    assert anomalies, "sentinel did not trip on the Inf loss"
+    for a in anomalies:
+        _schema_check(a)
+    assert anomalies[0]["kind"] == "nan_inf_loss"
+    assert anomalies[0]["mode"] == "warn"
+    assert not math.isfinite(float(anomalies[0]["cost"]))
+    assert records[-1]["type"] == "end"  # run finished normally
+
+
+def test_inf_loss_halt_mode_raises_with_crash_report(
+        tmp_path, monkeypatch):
+    run = _poisoned_train(tmp_path, monkeypatch, mode="halt")
+    with pytest.raises(sentinel.TrainingAnomaly):
+        run()
+    records = _records(tmp_path)
+    crashes = [r for r in records if r["type"] == "crash_report"]
+    assert len(crashes) == 1
+    _schema_check(crashes[0])
+    ring = crashes[0]["steps"]
+    assert ring, "crash report must contain the step ring"
+    assert ring[-1]["step"] == crashes[0]["anomaly"]["step"]
+    costs = [s.get("cost") for s in ring]
+    assert any(isinstance(c, str) for c in costs)  # the bad step is in
+    # the standalone artifact parses and matches the record
+    artifact = crashes[0]["artifact"]
+    assert os.path.exists(artifact)
+    body = json.load(open(artifact))
+    assert body["format"] == sentinel.ARTIFACT_FORMAT
+    assert body["steps"] == ring
+    # steplog closed cleanly despite the raise (end record written)
+    assert records[-1]["type"] == "end"
+
+
+def test_clean_run_emits_no_anomalies(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY", str(tmp_path))
+    monkeypatch.delenv(sentinel.SENTINEL_ENV, raising=False)
+    import paddle_tpu as paddle
+    from paddle_tpu import activation as A
+    from paddle_tpu import data_type as dt
+    from paddle_tpu import layer as L
+    from paddle_tpu import minibatch
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.parameters import Parameters
+
+    x = L.data(name="x", type=dt.dense_vector(4))
+    lab = L.data(name="y", type=dt.integer_value(2))
+    out = L.fc(input=x, size=2, act=A.Softmax())
+    cost = L.classification_cost(input=out, label=lab)
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost, params, opt.Momentum(momentum=0.9, learning_rate=0.05))
+
+    def reader():
+        rng = np.random.RandomState(1)
+        for _ in range(16):
+            xv = rng.randn(4).astype(np.float32)
+            yield xv, int(xv[0] > 0)
+
+    trainer.train(minibatch.batch(reader, 8), num_passes=1)
+    records = _records(tmp_path)
+    assert [r for r in records if r["type"] in ("anomaly",
+                                                "crash_report")] == []
+    assert glob.glob(str(tmp_path / "*.crash*.json")) == []

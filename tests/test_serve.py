@@ -475,7 +475,8 @@ def test_http_server_infer_and_health(engine_bundle):
         try:
             health = json.load(urllib.request.urlopen(base + "/healthz",
                                                       timeout=30))
-            assert health == {"ok": True, "bundle": "mnist_mlp"}
+            assert health == {"ok": True, "live": True, "ready": True,
+                              "bundle": "mnist_mlp"}
             x = np.random.RandomState(5).randn(2, 784).astype(np.float32)
             body = json.dumps({"inputs": {"pixel": x.tolist()}}).encode()
             req = urllib.request.Request(
