@@ -51,7 +51,7 @@ _SUBMODULES = (
     "activation", "attr", "data_type", "layer", "networks", "pooling",
     "initializer", "optimizer", "parameters", "trainer", "event", "inference",
     "evaluator", "reader", "minibatch", "dataset", "parallel", "image",
-    "topology", "config", "ops", "models", "interop", "serve",
+    "topology", "config", "ops", "models", "interop", "serve", "data",
 )
 
 

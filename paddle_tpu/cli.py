@@ -134,7 +134,8 @@ def cmd_train(args):
         if isinstance(event, ev.EndPass) and save_dir:
             trainer.save_checkpoint(save_dir, pass_id=event.pass_id)
 
-    trainer.train(reader, num_passes=args.num_passes, event_handler=handler)
+    trainer.train(reader, num_passes=args.num_passes, event_handler=handler,
+                  feed_pipeline=getattr(args, "feed_pipeline", 0) or False)
     if hasattr(cfg, "test_reader"):
         result = trainer.test(minibatch.batch(cfg.test_reader(), batch_size))
         print("test cost=%.6f metrics=%s" % (result.cost, result.metrics))
@@ -423,6 +424,14 @@ def cmd_observe(args):
                   % (run["wall_ms_p50"], run["wall_ms_p95"],
                      run["wall_ms_p99"], run["wall_ms_steady_mean"],
                      run["wall_ms_min"], run["wall_ms_mean"]))
+        if "feed_stall_ms_p50" in run:
+            waste = ("  padding waste %.1f%%"
+                     % run["feed_padding_waste_pct"]
+                     if "feed_padding_waste_pct" in run else "")
+            print("    feed stall ms: p50 %.3f  p95 %.3f  "
+                  "(%d pipelined batches)%s"
+                  % (run["feed_stall_ms_p50"], run["feed_stall_ms_p95"],
+                     run["feed_batches"], waste))
         if "examples_per_sec_best" in run:
             print("    examples/sec best: %.1f"
                   % run["examples_per_sec_best"])
@@ -467,6 +476,9 @@ def main(argv=None):
     p.add_argument("--num-passes", type=int, default=1)
     p.add_argument("--save-dir", default="")
     p.add_argument("--init-model", default="")
+    p.add_argument("--feed-pipeline", type=int, default=0,
+                   help="pipelined input feed depth (paddle_tpu.data, "
+                        "docs/data.md); 0 = synchronous feed")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("test", parents=[common])

@@ -140,6 +140,76 @@ class SequenceBatch:
         )
 
 
+class PackedSequenceBatch(SequenceBatch):
+    """A SequenceBatch whose rows each hold SEVERAL concatenated source
+    sequences (sequence packing): ``data`` [B, T, ...], ``lengths`` [B]
+    (TOTAL valid length of each packed row) plus ``segments`` [B, T] —
+    the per-row ordinal of the source sequence occupying each position
+    (0, 1, 2, ... within the row; -1 in padding).
+
+    Packing is the data-side half of the bargain; the model side is the
+    segment-RESET mask: recurrent scans must re-zero their carry at every
+    segment start so state never leaks across packed neighbours
+    (ops/rnn.py ``reset_bt``), and per-position costs mask on the packed
+    ``lengths`` exactly as they do for plain batches. With both in place
+    a packed batch computes bit-for-bit the same per-position outputs,
+    costs and gradients as the unpacked baseline
+    (tests/test_data_pipeline.py gradient-match). Built by
+    ``paddle_tpu.data.bucketing.pack_feed``.
+    """
+
+    def __init__(self, data, lengths, segments):
+        super().__init__(data, lengths)
+        self.segments = segments
+
+    def map_data(self, fn):
+        return PackedSequenceBatch(fn(self.data), self.lengths,
+                                   self.segments)
+
+    def reset_mask(self, dtype=None):
+        """[B, T] mask, 1 at every packed-segment start (the positions
+        where a recurrent carry must reset to its initial state)."""
+        seg = self.segments
+        prev = jnp.concatenate(
+            [jnp.full_like(seg[:, :1], -2), seg[:, :-1]], axis=1)
+        m = (seg >= 0) & (seg != prev)
+        return m if dtype is None else m.astype(dtype)
+
+    def segment_count(self):
+        """Total number of real (unpacked) sequences in the batch."""
+        return jnp.sum(jnp.max(self.segments, axis=1) + 1)
+
+    def reverse(self):
+        """Reverse each PACKED SEGMENT in place (not the whole row) —
+        the packed equivalent of SequenceBatch.reverse, used by
+        reverse-direction recurrent layers. Segment spans are unchanged,
+        so ``segments`` (and the reset mask) are preserved."""
+        t_max = self.max_len
+        t = jnp.arange(t_max)
+
+        def row_index(seg_row):
+            # padding gets its own segment id (t_max) so it can never
+            # collide with a real segment ordinal (< t_max)
+            sid = jnp.where(seg_row >= 0, seg_row, t_max)
+            first = jax.ops.segment_min(t, sid, num_segments=t_max + 1)
+            last = jax.ops.segment_max(t, sid, num_segments=t_max + 1)
+            return jnp.where(seg_row >= 0, first[sid] + last[sid] - t, t)
+
+        idx = jax.vmap(row_index)(self.segments)
+        data = jnp.take_along_axis(
+            self.data, idx.reshape(idx.shape + (1,) * (self.data.ndim - 2)),
+            axis=1)
+        return PackedSequenceBatch(data, self.lengths, self.segments)
+
+    def __repr__(self):
+        return "PackedSequenceBatch(data=%s%s, lengths=%s, segments=%s)" % (
+            getattr(self.data, "dtype", "?"),
+            tuple(self.data.shape),
+            tuple(self.lengths.shape),
+            tuple(self.segments.shape),
+        )
+
+
 class NestedSequenceBatch:
     """Two-level nested sequences: [B, S, T, ...] + outer [B] + inner [B, S].
 
@@ -227,6 +297,11 @@ jax.tree_util.register_pytree_node(
     SequenceBatch,
     lambda s: ((s.data, s.lengths), None),
     lambda _, children: SequenceBatch(*children),
+)
+jax.tree_util.register_pytree_node(
+    PackedSequenceBatch,
+    lambda s: ((s.data, s.lengths, s.segments), None),
+    lambda _, children: PackedSequenceBatch(*children),
 )
 jax.tree_util.register_pytree_node(
     NestedSequenceBatch,

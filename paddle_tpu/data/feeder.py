@@ -1,0 +1,232 @@
+"""DeviceFeeder: pipelined batch assembly + device prefetch.
+
+The reference ran data ingestion as its own concurrent subsystem:
+PyDataProvider2's pool thread double-buffered host batches while the
+trainer consumed them (gserver/dataproviders/PyDataProvider2.cpp:334).
+Our trainer historically called ``convert_feed`` synchronously on the
+step thread — on a fast device the step blocks on host-side numpy
+work. The DeviceFeeder moves the whole feed span off the critical
+thread:
+
+* a background **producer** thread runs the reader, converts each
+  minibatch (``topology.convert_feed``, honoring a BucketBatch's exact
+  pad target) and PLACES it on the device — sharding-aware: with a
+  ``parallelism`` (parallel.mesh.DataParallel) the batch is
+  ``jax.device_put`` onto the global-mesh 'data' axis exactly as
+  ``shard_train_step`` would have, so the transfer happens ahead of the
+  step instead of inside it (the layout distributed/worker.py trains
+  with);
+* a bounded queue keeps up to ``depth`` batches device-resident ahead
+  of the step;
+* the consumer (`batches()`) yields :class:`FeedBatch` records carrying
+  the feed plus its timing/waste accounting; the time the step thread
+  spends blocked on the queue is the **feed stall** — the number that
+  tells you a run is input-bound.
+
+Shutdown/cancellation is clean in both directions: a consumer that
+stops early (break / exception / GC of the generator) cancels the
+producer, which exits promptly even while blocked on a full queue; a
+producer error (reader or conversion raising) is re-raised on the
+consumer thread with the original traceback.
+
+Observability: every yielded batch updates the process-wide metrics
+registry (``paddle_tpu_data_*`` series: feed-stall histogram, queue
+depth, per-bucket fill/waste gauges — the training twins of the serve
+engine's per-bucket series) and the stall is recorded as a ``feed``
+span so traces show the step thread's wait. The trainer additionally
+writes a ``feed`` steplog record per step (docs/observability.md).
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.data.bucketing import BucketBatch, batch_waste
+from paddle_tpu.observe import metrics as observe_metrics
+from paddle_tpu.observe import spans as observe_spans
+# ONE cancellation handshake for every producer/consumer thread pair in
+# the codebase (poll interval, shutdown ordering): the reader
+# decorators' helpers are reused here, not re-implemented
+from paddle_tpu.reader.decorator import _cancellable_put, _drain
+
+
+class _End:
+    pass
+
+
+class _Error:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class FeedBatch:
+    """One pipelined batch: the device-resident ``feed`` dict plus its
+    accounting — ``examples`` (rows), ``convert_ms`` (host assembly +
+    device dispatch on the producer thread), ``stall_ms`` (time the
+    consumer blocked waiting for it), and for sequence feeds ``bucket``
+    (padded length), ``fill_tokens``/``pad_tokens``."""
+
+    __slots__ = ("feed", "examples", "convert_ms", "stall_ms", "bucket",
+                 "fill_tokens", "pad_tokens")
+
+    def __init__(self, feed, examples, convert_ms, bucket=None,
+                 fill_tokens=None, pad_tokens=None):
+        self.feed = feed
+        self.examples = examples
+        self.convert_ms = convert_ms
+        self.stall_ms = None  # set by the consumer
+        self.bucket = bucket
+        self.fill_tokens = fill_tokens
+        self.pad_tokens = pad_tokens
+
+
+def _seq_stats(feed):
+    """(padded_len, fill_tokens, pad_tokens) over the sequence slots of a
+    converted feed (None when the feed has no sequence slots)."""
+    from paddle_tpu.core.sequence import SequenceBatch
+
+    bucket = fill = slots = 0
+    for value in feed.values():
+        if isinstance(value, SequenceBatch):
+            lens = np.asarray(value.lengths)
+            bucket = max(bucket, int(value.max_len))
+            fill += int(lens.sum())
+            slots += int(lens.shape[0]) * int(value.max_len)
+    if slots == 0:
+        return None, None, None
+    return bucket, fill, slots - fill
+
+
+class DeviceFeeder:
+    """Background-thread feed pipeline over a minibatch reader.
+
+    ``DeviceFeeder(reader, topology).batches()`` yields FeedBatch items;
+    each call to ``batches()`` starts a fresh producer thread (one per
+    training pass, mirroring the per-pass ``reader()`` iterator). Use
+    ``convert=`` to override batch conversion (e.g. ``pack_feed``) —
+    signature ``convert(topology, data_batch, feeding, max_len)``.
+    """
+
+    def __init__(self, reader, topology, feeding=None, depth=2,
+                 parallelism=None, convert=None, metrics_registry=None):
+        if depth < 1:
+            raise ValueError("DeviceFeeder depth must be >= 1")
+        self.reader = reader
+        self.topology = topology
+        self.feeding = feeding
+        self.depth = int(depth)
+        self.parallelism = parallelism
+        self._convert = convert
+        m = metrics_registry or observe_metrics.get_registry()
+        self.metrics = m
+        self._m_stall = m.histogram(
+            "paddle_tpu_data_feed_stall_ms",
+            help="time the step thread blocked waiting for a pipelined "
+                 "batch")
+        self._m_convert = m.histogram(
+            "paddle_tpu_data_feed_convert_ms",
+            help="producer-thread batch conversion + device dispatch time")
+        self._m_batches = m.counter(
+            "paddle_tpu_data_batches_total",
+            help="batches assembled by the feed pipeline")
+        self._m_depth = m.gauge(
+            "paddle_tpu_data_queue_depth",
+            help="device-resident batches waiting ahead of the step")
+        self._per_bucket = {}
+
+    # -- producer side ------------------------------------------------------
+    def _convert_batch(self, data_batch):
+        from paddle_tpu.topology import convert_feed
+
+        max_len = data_batch.bucket if isinstance(data_batch, BucketBatch) \
+            else None
+        if self._convert is not None:
+            feed = self._convert(self.topology, data_batch, self.feeding,
+                                 max_len)
+        else:
+            feed = convert_feed(self.topology, data_batch, self.feeding,
+                                max_len=max_len)
+        if self.parallelism is not None:
+            # the DataParallel global-mesh placement shard_train_step
+            # would apply — done HERE so the transfer overlaps compute
+            feed = self.parallelism.shard_batch(feed)
+        return feed
+
+    def _produce(self, q, cancel):
+        def put(item):
+            return _cancellable_put(q, item, cancel)
+
+        try:
+            for data_batch in self.reader():
+                t0 = time.perf_counter()
+                feed = self._convert_batch(data_batch)
+                convert_ms = (time.perf_counter() - t0) * 1e3
+                bucket, fill, pad = _seq_stats(feed)
+                fb = FeedBatch(feed, len(data_batch), convert_ms,
+                               bucket=bucket, fill_tokens=fill,
+                               pad_tokens=pad)
+                if not put(fb):
+                    return
+                if cancel.is_set():
+                    return
+        except BaseException as exc:  # re-raised on the consumer thread
+            put(_Error(exc))
+            return
+        put(_End)
+
+    # -- consumer side ------------------------------------------------------
+    def batches(self):
+        """Generator of FeedBatch items; owns the producer thread for
+        its lifetime (closing the generator cancels and joins it)."""
+        q = queue.Queue(maxsize=self.depth)
+        cancel = threading.Event()
+        thread = threading.Thread(
+            target=self._produce, args=(q, cancel),
+            name="data-feeder-producer", daemon=True)
+        thread.start()
+        try:
+            while True:
+                with observe_spans.span("feed",
+                                        args={"pipelined": True}) as scope:
+                    item = q.get()
+                if item is _End:
+                    return
+                if isinstance(item, _Error):
+                    raise item.exc
+                item.stall_ms = scope.dur * 1e3
+                self._m_stall.observe(item.stall_ms)
+                self._m_convert.observe(item.convert_ms)
+                self._m_batches.inc()
+                self._m_depth.set(q.qsize())
+                if item.bucket:
+                    self._bucket_gauges(item)
+                yield item
+        finally:
+            cancel.set()
+            # wake a producer blocked on a full queue, then let it finish
+            _drain(q)
+            thread.join(timeout=5.0)
+
+    def _bucket_gauges(self, fb):
+        """Cumulative per-bucket fill/waste — the training twins of the
+        serve engine's paddle_tpu_serve_*_ratio{bucket=} series."""
+        pb = self._per_bucket.setdefault(fb.bucket, [0, 0])
+        pb[0] += fb.fill_tokens
+        pb[1] += fb.pad_tokens
+        fill, pad = pb
+        slots = fill + pad
+        label = {"bucket": str(fb.bucket)}
+        self.metrics.gauge("paddle_tpu_data_bucket_fill_ratio",
+                           help="sequence tokens / padded slots "
+                                "(cumulative, per padded length)",
+                           labels=label).set(fill / slots)
+        self.metrics.gauge("paddle_tpu_data_padding_waste_ratio",
+                           help="padding slots / padded slots "
+                                "(cumulative, per padded length)",
+                           labels=label).set(pad / slots)
+
+

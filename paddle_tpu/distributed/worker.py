@@ -33,6 +33,11 @@ def main(argv=None):
     ap.add_argument("--num-passes", type=int, default=1)
     ap.add_argument("--batch-size", type=int, default=None)
     ap.add_argument("--use-tpu", action="store_true", default=False)
+    ap.add_argument("--feed-pipeline", type=int, default=0,
+                    help="pipelined input feed depth (paddle_tpu.data): "
+                         "batches convert and jax.device_put onto the "
+                         "GLOBAL data-parallel mesh on a background "
+                         "thread, ahead of the step; 0 = synchronous")
     args = ap.parse_args(argv)
 
     if args.use_tpu:
@@ -65,7 +70,8 @@ def main(argv=None):
     costs = []
     trainer.train(reader, num_passes=args.num_passes,
                   event_handler=lambda e: costs.append(float(e.cost))
-                  if getattr(e, "cost", None) is not None else None)
+                  if getattr(e, "cost", None) is not None else None,
+                  feed_pipeline=args.feed_pipeline or False)
 
     final = {"process_id": args.process_id,
              "processes": jax.process_count(),

@@ -12,7 +12,11 @@ import jax.numpy as jnp
 
 from paddle_tpu.activation import to_activation
 from paddle_tpu.attr import ExtraAttr, ParamAttr
-from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
+from paddle_tpu.core.sequence import (
+    NestedSequenceBatch,
+    PackedSequenceBatch,
+    SequenceBatch,
+)
 from paddle_tpu.graph import LayerNode, ParamSpec
 from paddle_tpu.initializer import Constant, Normal, Xavier, default_bias_init
 from paddle_tpu.utils.error import enforce
@@ -132,6 +136,20 @@ def featurewise(fn, value):
     return fn(value)
 
 
+def reject_packed(value, what):
+    """Layers that reduce or mix across TIME positions are undefined on
+    packed rows (core/sequence.py PackedSequenceBatch): a per-sequence
+    reduction would collapse all packed neighbours into one output, a
+    context window would read across segment boundaries — silently.
+    Refuse loudly instead (use length bucketing, not packing, for such
+    models — docs/data.md)."""
+    enforce(not isinstance(value, PackedSequenceBatch),
+            "%s does not support packed sequence batches: it would mix "
+            "packed neighbours across segment boundaries; use length "
+            "bucketing (paddle_tpu.data.bucketing) instead of packing",
+            what)
+
+
 def data_of(value):
     if isinstance(value, (SequenceBatch, NestedSequenceBatch)):
         return value.data
@@ -149,6 +167,10 @@ def data_of(value):
 
 def like(value, new_data):
     """Rewrap new_data with value's sequence metadata."""
+    if isinstance(value, PackedSequenceBatch):
+        # packing metadata (segment ids) survives featurewise layers so a
+        # downstream recurrent layer still sees the segment-reset mask
+        return PackedSequenceBatch(new_data, value.lengths, value.segments)
     if isinstance(value, SequenceBatch):
         return SequenceBatch(new_data, value.lengths)
     if isinstance(value, NestedSequenceBatch):

@@ -45,6 +45,15 @@ def crf(input, label, size=None, weight=None, param_attr=None, name=None,
     def forward(params, values, ctx):
         scores, labels = values[0], values[1]
         enforce(is_seq(scores) and is_seq(labels), "crf expects sequences")
+        from paddle_tpu.core.sequence import PackedSequenceBatch
+
+        # the chain's transition scores would silently bridge packed
+        # neighbours — CRF costs need plain (bucketed, not packed) batches
+        enforce(not isinstance(scores, PackedSequenceBatch)
+                and not isinstance(labels, PackedSequenceBatch),
+                "crf does not support packed sequence batches: transitions "
+                "would cross packed-segment boundaries; use length "
+                "bucketing (paddle_tpu.data.bucketing) instead of packing")
         nll = crf_ops.crf_nll(scores.data, labels.data, scores.mask(),
                               params[wspec.name])
         if weight is not None:
@@ -74,6 +83,9 @@ def crf_decoding(input, size=None, label=None, param_attr=None, name=None,
     def forward(params, values, ctx):
         scores = values[0]
         enforce(is_seq(scores), "crf_decoding expects a sequence")
+        from paddle_tpu.layer.base import reject_packed
+
+        reject_packed(scores, "crf_decoding")  # viterbi bridges segments
         paths, _ = crf_ops.crf_decode(scores.data, scores.mask(),
                                       params[wspec.name])
         if label is not None:
@@ -106,6 +118,10 @@ def ctc(input, label, size=None, name=None, norm_by_times=False,
     def forward(params, values, ctx):
         scores, labels = values[0], values[1]
         enforce(is_seq(scores) and is_seq(labels), "ctc expects sequences")
+        from paddle_tpu.layer.base import reject_packed
+
+        reject_packed(scores, "ctc")  # alignment bridges segments
+        reject_packed(labels, "ctc")
         x = scores.data
         if is_probs:
             logp = jnp.log(x + _EPS)

@@ -12,7 +12,7 @@ size. The recurrent_group / memory / beam-search machinery
 import jax.numpy as jnp
 
 from paddle_tpu.activation import to_activation
-from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.core.sequence import PackedSequenceBatch, SequenceBatch
 from paddle_tpu.layer.base import (
     as_nhwc,
     bias_spec,
@@ -25,6 +25,25 @@ from paddle_tpu.layer.base import (
 )
 from paddle_tpu.ops import rnn as rnn_ops
 from paddle_tpu.utils.error import enforce
+
+
+def _run_seq_scan(x, inp, reverse, scan_fn):
+    """Run a masked recurrent scan over a (possibly packed) sequence
+    input ``x`` whose (bias-adjusted) projection is ``inp``.
+
+    ``scan_fn(data, reset_bt, reverse) -> h_seq [B, T, H]``. Plain
+    SequenceBatch: the scan handles ``reverse`` itself (unchanged fast
+    path, fused kernels eligible). PackedSequenceBatch: the carry resets
+    at segment starts (ops/rnn.py ``reset_bt``) and reverse
+    pre/post-reverses PER SEGMENT (PackedSequenceBatch.reverse), so a
+    packed row computes exactly what its unpacked sequences would."""
+    if not isinstance(x, PackedSequenceBatch):
+        return SequenceBatch(scan_fn(inp, None, reverse), x.lengths)
+    px = PackedSequenceBatch(inp, x.lengths, x.segments)
+    data = px.reverse().data if reverse else px.data
+    h_seq = scan_fn(data, px.reset_mask(), False)
+    out = PackedSequenceBatch(h_seq, x.lengths, x.segments)
+    return out.reverse() if reverse else out
 
 
 # Default sentinel for gate_bias_attr: a dedicated object (not a string,
@@ -112,21 +131,25 @@ def lstmemory(input, name=None, size=None, reverse=False, act=None,
             gates = gates + bias[: 4 * size]
             if peephole:
                 w_peep = bias[4 * size:]
-        h_seq, _ = rnn_ops.lstm_scan(
-            gates,
-            x.mask(gates.dtype),
-            w_in=None,
-            b=None,
-            w_rec=params[wspec.name],
-            gate_act=g_act,
-            state_act=s_act,
-            reverse=reverse,
-            use_peephole=peephole,
-            w_peep=w_peep,
-            standard_acts=standard_acts,
-            out_act=o_act,
-        )
-        return SequenceBatch(h_seq, x.lengths)
+        def scan_fn(data, reset_bt, rev):
+            h_seq, _ = rnn_ops.lstm_scan(
+                data,
+                x.mask(gates.dtype),
+                w_in=None,
+                b=None,
+                w_rec=params[wspec.name],
+                gate_act=g_act,
+                state_act=s_act,
+                reverse=rev,
+                use_peephole=peephole,
+                w_peep=w_peep,
+                standard_acts=standard_acts,
+                out_act=o_act,
+                reset_bt=reset_bt,
+            )
+            return h_seq
+
+        return _run_seq_scan(x, gates, reverse, scan_fn)
 
     specs = [s for s in (wspec, gspec, bspec) if s is not None]
     return make_node("lstmemory", forward, [input], name=name, size=size,
@@ -158,18 +181,23 @@ def grumemory(input, name=None, size=None, reverse=False, act=None,
         if bspec is not None:
             proj = proj + params[bspec.name]
         w = params[wspec.name]
-        h_seq, _ = rnn_ops.gru_scan(
-            proj,
-            x.mask(proj.dtype),
-            w_in=None,
-            b=None,
-            w_rec_rz=w[:, :2 * size],
-            w_rec_c=w[:, 2 * size:],
-            gate_act=g_act,
-            state_act=s_act,
-            reverse=reverse,
-        )
-        return SequenceBatch(h_seq, x.lengths)
+
+        def scan_fn(data, reset_bt, rev):
+            h_seq, _ = rnn_ops.gru_scan(
+                data,
+                x.mask(proj.dtype),
+                w_in=None,
+                b=None,
+                w_rec_rz=w[:, :2 * size],
+                w_rec_c=w[:, 2 * size:],
+                gate_act=g_act,
+                state_act=s_act,
+                reverse=rev,
+                reset_bt=reset_bt,
+            )
+            return h_seq
+
+        return _run_seq_scan(x, proj, reverse, scan_fn)
 
     specs = [s for s in (wspec, bspec) if s is not None]
     return make_node("grumemory", forward, [input], name=name, size=size,
@@ -195,9 +223,13 @@ def recurrent(input, name=None, act=None, reverse=False, bias_attr=None,
         inp = x.data
         if bspec is not None:
             inp = inp + params[bspec.name]
-        h_seq, _ = rnn_ops.rnn_scan(
-            inp, x.mask(inp.dtype), params[wspec.name], act=act_fn, reverse=reverse)
-        return SequenceBatch(h_seq, x.lengths)
+        def scan_fn(data, reset_bt, rev):
+            h_seq, _ = rnn_ops.rnn_scan(
+                data, x.mask(inp.dtype), params[wspec.name], act=act_fn,
+                reverse=rev, reset_bt=reset_bt)
+            return h_seq
+
+        return _run_seq_scan(x, inp, reverse, scan_fn)
 
     specs = [s for s in (wspec, bspec) if s is not None]
     return make_node("recurrent", forward, [input], name=name, size=size,

@@ -433,9 +433,14 @@ def recurrent_group(step, input, reverse=False, name=None, targetInlink=None):
                 return _nested_forward(program, slot_of, graph_inputs,
                                        out_idx, reverse, params, values,
                                        ctx, seq_vals)
+            from paddle_tpu.layer.base import reject_packed
+
             for sv in seq_vals:
                 enforce(is_seq(sv),
                         "recurrent_group inputs must be sequences")
+                # the group's memory carry has no segment-reset path —
+                # packed rows would leak state across neighbours
+                reject_packed(sv, "recurrent_group")
             ref = seq_vals[0]
             batch = ref.batch_size
             dtype = ref.data.dtype

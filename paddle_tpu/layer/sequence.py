@@ -20,6 +20,7 @@ from paddle_tpu.layer.base import (
     like,
     make_node,
     register_layer,
+    reject_packed,
     to_list,
     weight_spec,
 )
@@ -40,6 +41,7 @@ def pooling(input, pooling_type=None, name=None, bias_attr=False, agg_level=0,
 
     def forward(params, values, ctx):
         x = values[0]
+        reject_packed(x, "pooling")
         if stride > 0:
             enforce(not isinstance(x, NestedSequenceBatch),
                     "pooling stride over nested sequences is not supported")
@@ -122,6 +124,7 @@ def last_seq(input, name=None, agg_level=0, stride=-1, layer_attr=None):
 
     def forward(params, values, ctx):
         x = values[0]
+        reject_packed(x, "last_seq")
         if isinstance(x, NestedSequenceBatch):
             if agg_level:
                 inner = x.flatten_to_subsequences()
@@ -144,6 +147,7 @@ def first_seq(input, name=None, agg_level=0, stride=-1, layer_attr=None):
 
     def forward(params, values, ctx):
         x = values[0]
+        reject_packed(x, "first_seq")
         if isinstance(x, NestedSequenceBatch):
             if agg_level:
                 inner = x.flatten_to_subsequences()
@@ -166,6 +170,8 @@ def expand(input, expand_as, name=None, bias_attr=False, expand_level=0,
     def forward(params, values, ctx):
         x, target = values[0], values[1]
         enforce(is_seq(target), "expand_as input must be a sequence")
+        reject_packed(x, "expand")
+        reject_packed(target, "expand")
         xd = data_of(x)
         if is_seq(x):  # outer sequence expanded into nested target handled upstream
             xd = x.data
@@ -184,6 +190,8 @@ def seq_concat(a, b, name=None, act=None, bias_attr=False, layer_attr=None):
     def forward(params, values, ctx):
         xa, xb = values[0], values[1]
         enforce(is_seq(xa) and is_seq(xb), "seq_concat expects sequences")
+        reject_packed(xa, "seq_concat")
+        reject_packed(xb, "seq_concat")
         b_, ta, d = xa.data.shape
         tb = xb.data.shape[1]
         total = ta + tb
@@ -215,6 +223,7 @@ def seq_reshape(input, reshape_size, name=None, act=None, bias_attr=False,
     def forward(params, values, ctx):
         x = values[0]
         enforce(is_seq(x), "seq_reshape expects a sequence")
+        reject_packed(x, "seq_reshape")
         b, t, d = x.data.shape
         enforce((t * d) % reshape_size == 0, "cannot reshape %dx%d to width %d",
                 t, d, reshape_size)
@@ -238,6 +247,7 @@ def seq_slice(input, starts=None, ends=None, name=None, layer_attr=None):
     def forward(params, values, ctx):
         x = values[0]
         enforce(is_seq(x), "seq_slice expects a sequence")
+        reject_packed(x, "seq_slice")
         idx = 1
         if starts is not None:
             s = data_of(values[idx]).reshape(-1).astype(jnp.int32)
@@ -268,6 +278,7 @@ def sub_seq(input, offsets, sizes, name=None, act=None, bias_attr=False,
 
     def forward(params, values, ctx):
         x, off, sz = values[0], data_of(values[1]), data_of(values[2])
+        reject_packed(x, "sub_seq")
         off = off.reshape(-1).astype(jnp.int32)
         sz = sz.reshape(-1).astype(jnp.int32)
         t = jnp.arange(x.max_len)[None, :]
@@ -301,6 +312,7 @@ def context_projection_layer(input, context_start, context_len,
     def forward(params, values, ctx):
         x = values[0]
         enforce(is_seq(x), "context projection expects a sequence")
+        reject_packed(x, "context_projection")  # window spans segments
         padding = params[specs[0].name] if specs else None
         out = seq_ops.context_projection(
             x.data, x.mask(), context_start, context_len, padding)
@@ -324,6 +336,7 @@ def row_conv(input, context_len, act=None, name=None, param_attr=None,
     def forward(params, values, ctx):
         x = values[0]
         enforce(is_seq(x), "row_conv expects a sequence")
+        reject_packed(x, "row_conv")  # lookahead window spans segments
         out = seq_ops.row_conv(x.data, x.mask(), params[wspec.name])
         return finalize(SequenceBatch(out, x.lengths), act, node.extra_attr, ctx)
 

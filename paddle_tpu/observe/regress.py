@@ -39,7 +39,7 @@ GATE_ENV = "PADDLE_TPU_BENCH_GATE"
 # units where a SMALLER value is better; everything rate-like is
 # bigger-better. Metrics whose direction cannot be determined are not
 # gated (status "ungated").
-_LOWER_BETTER_UNITS = ("ms/batch", "ms/step", "ms", "s")
+_LOWER_BETTER_UNITS = ("ms/batch", "ms/step", "ms", "s", "pct_waste")
 _HIGHER_BETTER_UNITS = ("samples/s", "qps", "MB/s", "checks_passed",
                         "checks")
 

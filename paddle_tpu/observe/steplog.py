@@ -25,6 +25,13 @@ Record types (field ``type``):
   ``event``, ``secs``.
 * ``bench_row`` — a benchmark record mirrored by benchmark/run.py, so
   BENCH rows and telemetry can never disagree.
+* ``feed``  — one pipelined input batch (paddle_tpu.data.feeder, only
+  written when the trainer runs with ``feed_pipeline=``): ``step`` it
+  fed, ``stall_ms`` (time the step thread blocked waiting for it — the
+  input-bound signal), optional ``convert_ms`` (producer-thread
+  conversion + device dispatch), ``examples``, ``depth`` (pipeline
+  depth), and for sequence feeds ``bucket`` (padded length),
+  ``fill_tokens``/``pad_tokens`` (padding-waste accounting).
 * ``serve_request`` — one completed inference request through the
   serving engine (paddle_tpu.serve): ``rows``, ``queue_ms`` (time spent
   waiting for a batch flush), ``latency_ms`` (enqueue -> result),
@@ -242,6 +249,27 @@ class StepLog:
         self.write(rec)
         self._steps += 1
 
+    def log_feed(self, step, stall_ms, convert_ms=None, examples=None,
+                 depth=None, bucket=None, fill_tokens=None,
+                 pad_tokens=None):
+        """One pipelined input batch (paddle_tpu.data.feeder)."""
+        rec = {"type": "feed", "step": int(step),
+               "stall_ms": round(float(stall_ms), 4),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if convert_ms is not None:
+            rec["convert_ms"] = round(float(convert_ms), 4)
+        if examples is not None:
+            rec["examples"] = int(examples)
+        if depth is not None:
+            rec["depth"] = int(depth)
+        if bucket:
+            rec["bucket"] = int(bucket)
+        if fill_tokens is not None:
+            rec["fill_tokens"] = int(fill_tokens)
+        if pad_tokens is not None:
+            rec["pad_tokens"] = int(pad_tokens)
+        self.write(rec)
+
     def log_serve_request(self, rows, queue_ms, latency_ms=None,
                           req_id=None):
         """One completed serving request (paddle_tpu.serve engine)."""
@@ -379,6 +407,22 @@ def summarize_dir(directory):
             for q, key in ((50, "wall_ms_p50"), (95, "wall_ms_p95"),
                            (99, "wall_ms_p99")):
                 run[key] = round(percentile(tail, q), 3)
+        feeds = [r for r in records if r.get("type") == "feed"]
+        stalls = [r["stall_ms"] for r in feeds if "stall_ms" in r]
+        if stalls:
+            from paddle_tpu.observe.metrics import percentile
+
+            # feed-bound visibility: stall percentiles print next to the
+            # step time in `cli observe` so one command answers "is this
+            # run input-bound?"
+            run["feed_batches"] = len(stalls)
+            run["feed_stall_ms_p50"] = round(percentile(stalls, 50), 3)
+            run["feed_stall_ms_p95"] = round(percentile(stalls, 95), 3)
+            pad = sum(r.get("pad_tokens", 0) for r in feeds)
+            fill = sum(r.get("fill_tokens", 0) for r in feeds)
+            if fill + pad:
+                run["feed_padding_waste_pct"] = round(
+                    100.0 * pad / (fill + pad), 2)
         ex = [r["examples_per_sec"] for r in steps
               if "examples_per_sec" in r]
         if ex:

@@ -92,10 +92,21 @@ def _scan_time_major(step_fn, init_carry, inputs_tm, mask_tm, reverse=False):
     return carry, ys
 
 
+def _check_reset(reset_bt, reverse):
+    """Packed-sequence reset masks compose with reverse only when the
+    caller pre-reverses per segment (PackedSequenceBatch.reverse) — the
+    scans' internal whole-row reverse would mix packed neighbours."""
+    if reset_bt is not None and reverse:
+        raise ValueError(
+            "reset_bt (packed sequences) cannot combine with reverse=True "
+            "inside the scan; pre-reverse per segment with "
+            "PackedSequenceBatch.reverse() and scan forward")
+
+
 def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
               gate_act=jax.nn.sigmoid, state_act=jnp.tanh, reverse=False,
               use_peephole=False, w_peep=None, standard_acts=None,
-              out_act=None):
+              out_act=None, reset_bt=None):
     """Full-sequence LSTM. x [B, T, D] -> h_seq [B, T, H], (h_T, c_T).
 
     The [B*T, D]x[D, 4H] projection runs outside the scan (one MXU GEMM);
@@ -104,10 +115,16 @@ def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
     state updates are masked, trailing padding passes through untouched,
     reproducing the reference's length-sorted reverse traversal.
 
+    ``reset_bt`` [B, T] (packed sequences, core/sequence.py
+    PackedSequenceBatch): positions where the carry re-zeroes to (h0, c0)
+    BEFORE the cell computes, so packed neighbours never see each
+    other's state. Takes the lax.scan path (no fused kernel).
+
     When ``standard_acts`` (sigmoid gates + tanh states) and no peephole,
     the whole scan runs as one fused Pallas kernel (ops/pallas_kernels.py —
     hl_cuda_lstm.cu parity, TPU-shaped); otherwise lax.scan.
     """
+    _check_reset(reset_bt, reverse)
     b_, t, d = x_btd.shape
     hidden = w_rec.shape[0]
     if w_in is None:  # input already projected to 4H (lstmemory contract)
@@ -140,7 +157,7 @@ def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
     # hl_cuda_lstm.cu handles all sizes). Only the real TPU backend (or the
     # tests' explicit interpret flag) takes this path — other backends
     # where pallas merely imports would fail at lowering.
-    if (pk.enabled() and standard_acts
+    if (reset_bt is None and pk.enabled() and standard_acts
             and gates_tm.dtype in (jnp.float32, jnp.bfloat16)
             and pk.lstm_mode(b_, hidden, gates_tm.dtype) is not None):
         h_seq_tm, h_f, c_f = pk.lstm_fused(
@@ -152,11 +169,26 @@ def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
                        state_act=state_act, use_peephole=use_peephole,
                        w_peep=w_peep, out_act=out_act)
 
-        def body(carry, xs):
-            g_t, m_t = xs
-            return step(carry, g_t, mask_t=m_t)
+        if reset_bt is None:
+            def body(carry, xs):
+                g_t, m_t = xs
+                return step(carry, g_t, mask_t=m_t)
 
-        (h_f, c_f), ys = lax.scan(body, (h0, c0), (gates_tm, mask_tm))
+            (h_f, c_f), ys = lax.scan(body, (h0, c0), (gates_tm, mask_tm))
+        else:
+            reset_tm = jnp.swapaxes(
+                reset_bt.astype(gates_tm.dtype), 0, 1)
+
+            def body(carry, xs):
+                g_t, m_t, r_t = xs
+                h_prev, c_prev = carry
+                keep = (1.0 - r_t)[:, None]
+                carry = (h_prev * keep + h0 * r_t[:, None],
+                         c_prev * keep + c0 * r_t[:, None])
+                return step(carry, g_t, mask_t=m_t)
+
+            (h_f, c_f), ys = lax.scan(body, (h0, c0),
+                                      (gates_tm, mask_tm, reset_tm))
     h_seq = jnp.swapaxes(ys, 0, 1)
     if reverse:
         from paddle_tpu.core.sequence import SequenceBatch
@@ -167,8 +199,12 @@ def lstm_scan(x_btd, mask_bt, w_in, b, w_rec, h0=None, c0=None,
 
 
 def gru_scan(x_btd, mask_bt, w_in, b, w_rec_rz, w_rec_c, h0=None,
-             gate_act=jax.nn.sigmoid, state_act=jnp.tanh, reverse=False):
-    """Full-sequence GRU; same batching strategy as lstm_scan."""
+             gate_act=jax.nn.sigmoid, state_act=jnp.tanh, reverse=False,
+             reset_bt=None):
+    """Full-sequence GRU; same batching strategy as lstm_scan.
+    ``reset_bt`` re-zeroes the carry to h0 at packed-segment starts
+    (see lstm_scan)."""
+    _check_reset(reset_bt, reverse)
     b_, t, d = x_btd.shape
     hidden = w_rec_c.shape[0]
     if w_in is None:  # input already projected to 3H (grumemory contract)
@@ -190,19 +226,29 @@ def gru_scan(x_btd, mask_bt, w_in, b, w_rec_rz, w_rec_c, h0=None,
     from paddle_tpu.ops import pallas_kernels as pk
 
     standard = gate_act is jax.nn.sigmoid and state_act is jnp.tanh
-    if (pk.enabled() and standard
+    if (reset_bt is None and pk.enabled() and standard
             and proj_tm.dtype in (jnp.float32, jnp.bfloat16)
             and pk.gru_mode(b_, hidden, proj_tm.dtype) is not None):
         # fused whole-sequence GRU kernel (hl_gpu_gru.cuh parity)
         ys, h_f = pk.gru_fused(proj_tm, mask_tm.astype(jnp.float32),
                                w_rec_rz, w_rec_c, h0)
-    else:
+    elif reset_bt is None:
         def body(carry, xs):
             p_t, m_t = xs
             return gru_step(carry, p_t, w_rec_rz, w_rec_c, m_t, gate_act,
                             state_act)
 
         h_f, ys = lax.scan(body, h0, (proj_tm, mask_tm))
+    else:
+        reset_tm = jnp.swapaxes(reset_bt.astype(proj_tm.dtype), 0, 1)
+
+        def body(carry, xs):
+            p_t, m_t, r_t = xs
+            carry = carry * (1.0 - r_t)[:, None] + h0 * r_t[:, None]
+            return gru_step(carry, p_t, w_rec_rz, w_rec_c, m_t, gate_act,
+                            state_act)
+
+        h_f, ys = lax.scan(body, h0, (proj_tm, mask_tm, reset_tm))
     h_seq = jnp.swapaxes(ys, 0, 1)
     if reverse:
         from paddle_tpu.core.sequence import SequenceBatch
@@ -212,10 +258,14 @@ def gru_scan(x_btd, mask_bt, w_in, b, w_rec_rz, w_rec_c, h0=None,
     return h_seq * mask_bt[..., None].astype(h_seq.dtype), h_f
 
 
-def rnn_scan(x_btd, mask_bt, w_rec, h0=None, act=jnp.tanh, reverse=False):
+def rnn_scan(x_btd, mask_bt, w_rec, h0=None, act=jnp.tanh, reverse=False,
+             reset_bt=None):
     """Vanilla RNN over a precomputed input projection x [B, T, H]
     (reference: RecurrentLayer — input is already projected by a preceding
-    fc/mixed layer, matching its 'input must equal hidden size' contract)."""
+    fc/mixed layer, matching its 'input must equal hidden size' contract).
+    ``reset_bt`` re-zeroes the carry to h0 at packed-segment starts
+    (see lstm_scan)."""
+    _check_reset(reset_bt, reverse)
     b_, t, hidden = x_btd.shape
     if h0 is None:
         h0 = jnp.zeros((b_, hidden), x_btd.dtype)
@@ -228,11 +278,21 @@ def rnn_scan(x_btd, mask_bt, w_rec, h0=None, act=jnp.tanh, reverse=False):
     inp_tm = jnp.swapaxes(inp, 0, 1)
     mask_tm = jnp.swapaxes(mask_bt, 0, 1)
 
-    def body(carry, xs):
-        i_t, m_t = xs
-        return rnn_step(carry, i_t, w_rec, m_t, act)
+    if reset_bt is None:
+        def body(carry, xs):
+            i_t, m_t = xs
+            return rnn_step(carry, i_t, w_rec, m_t, act)
 
-    h_f, ys = lax.scan(body, h0, (inp_tm, mask_tm))
+        h_f, ys = lax.scan(body, h0, (inp_tm, mask_tm))
+    else:
+        reset_tm = jnp.swapaxes(reset_bt.astype(inp_tm.dtype), 0, 1)
+
+        def body(carry, xs):
+            i_t, m_t, r_t = xs
+            carry = carry * (1.0 - r_t)[:, None] + h0 * r_t[:, None]
+            return rnn_step(carry, i_t, w_rec, m_t, act)
+
+        h_f, ys = lax.scan(body, h0, (inp_tm, mask_tm, reset_tm))
     h_seq = jnp.swapaxes(ys, 0, 1)
     if reverse:
         from paddle_tpu.core.sequence import SequenceBatch

@@ -73,15 +73,21 @@ class DataParallel:
         return NamedSharding(self.mesh, P())
 
     def shard_batch(self, tree):
-        """Place a host batch onto the mesh, sharded on axis 0."""
-        sharding = self.batch_sharding()
+        """Place a host batch onto the mesh, sharded on axis 0.
+        Idempotent: leaves already carrying their target sharding pass
+        through untouched, so a feed the DeviceFeeder pre-placed
+        (paddle_tpu.data.feeder) costs the step thread nothing here."""
         repl = self.replicated()
 
         def place(x):
             if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] % self.mesh.shape[self.axis] == 0:
-                return jax.device_put(x, NamedSharding(
-                    self.mesh, P(*([self.axis] + [None] * (x.ndim - 1)))))
-            return jax.device_put(x, repl)
+                want = NamedSharding(
+                    self.mesh, P(*([self.axis] + [None] * (x.ndim - 1))))
+            else:
+                want = repl
+            if getattr(x, "sharding", None) == want:
+                return x
+            return jax.device_put(x, want)
 
         return jax.tree_util.tree_map(place, tree)
 

@@ -90,32 +90,68 @@ class _End:
     pass
 
 
+def _cancellable_put(q, item, cancel, poll=0.1):
+    """Bounded-queue put that gives up when ``cancel`` is set — the
+    producer-side half of the abandoned-consumer fix: a worker blocked
+    on a full queue must wake up and exit when nobody will ever drain
+    it. Returns False when cancelled."""
+    while not cancel.is_set():
+        try:
+            q.put(item, timeout=poll)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _drain(q):
+    """Free producer slots so a blocked put wakes within one poll."""
+    try:
+        while True:
+            q.get_nowait()
+    except queue.Empty:
+        pass
+
+
 def buffered(reader, size):
     """Background-thread prefetch buffer (reference: buffered :180 — the
-    data-provider pool-thread overlap)."""
+    data-provider pool-thread overlap).
+
+    The fill thread exits promptly even when the CONSUMER abandons the
+    iterator early (break / exception / GC): closing the generator sets
+    a cancel event and drains the queue, so a put blocked on a full
+    queue wakes and the thread returns instead of leaking
+    (tests/test_readers.py leak regressions)."""
 
     def buffered_reader():
         q = queue.Queue(maxsize=size)
+        cancel = threading.Event()
         err = []
 
         def fill():
             try:
                 for sample in reader():
-                    q.put(sample)
+                    if not _cancellable_put(q, sample, cancel):
+                        return
             except BaseException as e:  # surfaced in consumer
                 err.append(e)
             finally:
-                q.put(_End)
+                _cancellable_put(q, _End, cancel)
 
-        t = threading.Thread(target=fill, daemon=True)
+        t = threading.Thread(target=fill, daemon=True,
+                             name="reader-buffered-fill")
         t.start()
-        while True:
-            sample = q.get()
-            if sample is _End:
-                if err:
-                    raise err[0]
-                return
-            yield sample
+        try:
+            while True:
+                sample = q.get()
+                if sample is _End:
+                    if err:
+                        raise err[0]
+                    return
+                yield sample
+        finally:
+            cancel.set()
+            _drain(q)
 
     return buffered_reader
 
@@ -182,55 +218,88 @@ def cache(reader):
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Parallel map with worker threads (reference: xmap_readers)."""
+    """Parallel map with worker threads (reference: xmap_readers).
+
+    Feed and worker threads exit promptly when the consumer abandons the
+    iterator early OR a mapper raises (the error is re-raised in the
+    consumer): every blocking queue operation is cancellable, and
+    closing the generator cancels + drains both queues — no thread
+    leaks through either path (tests/test_readers.py leak regressions).
+    """
 
     def xreader():
         in_q = queue.Queue(buffer_size)
         out_q = queue.Queue(buffer_size)
+        cancel = threading.Event()
         err = []
 
+        def _get(q):
+            while not cancel.is_set():
+                try:
+                    return q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            return _End
+
         def feed():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
+            try:
+                for i, sample in enumerate(reader()):
+                    if not _cancellable_put(in_q, (i, sample), cancel):
+                        return
+            except BaseException as e:  # source reader raised: surface it
+                err.append(e)
+            # ALWAYS deliver the worker sentinels — a feed thread dying
+            # without them would leave workers polling and the consumer
+            # blocked forever
             for _ in range(process_num):
-                in_q.put(_End)
+                if not _cancellable_put(in_q, _End, cancel):
+                    return
 
         def work():
             while True:
-                item = in_q.get()
+                item = _get(in_q)
                 if item is _End:
-                    out_q.put(_End)
+                    _cancellable_put(out_q, _End, cancel)
                     return
                 i, sample = item
                 try:
-                    out_q.put((i, mapper(sample)))
+                    mapped = mapper(sample)
                 except BaseException as e:
                     err.append(e)
-                    out_q.put(_End)
+                    _cancellable_put(out_q, _End, cancel)
+                    return
+                if not _cancellable_put(out_q, (i, mapped), cancel):
                     return
 
-        threading.Thread(target=feed, daemon=True).start()
+        threading.Thread(target=feed, daemon=True,
+                         name="reader-xmap-feed").start()
         for _ in range(process_num):
-            threading.Thread(target=work, daemon=True).start()
-        finished = 0
-        pending = {}
-        next_idx = 0
-        while finished < process_num:
-            item = out_q.get()
-            if item is _End:
-                finished += 1
-                if err:
-                    raise err[0]
-                continue
-            if not order:
-                yield item[1]
-            else:
-                pending[item[0]] = item[1]
-                while next_idx in pending:
-                    yield pending.pop(next_idx)
-                    next_idx += 1
-        while order and next_idx in pending:
-            yield pending.pop(next_idx)
-            next_idx += 1
+            threading.Thread(target=work, daemon=True,
+                             name="reader-xmap-work").start()
+        try:
+            finished = 0
+            pending = {}
+            next_idx = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    if err:
+                        raise err[0]
+                    continue
+                if not order:
+                    yield item[1]
+                else:
+                    pending[item[0]] = item[1]
+                    while next_idx in pending:
+                        yield pending.pop(next_idx)
+                        next_idx += 1
+            while order and next_idx in pending:
+                yield pending.pop(next_idx)
+                next_idx += 1
+        finally:
+            cancel.set()
+            _drain(in_q)
+            _drain(out_q)
 
     return xreader

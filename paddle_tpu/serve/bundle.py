@@ -97,14 +97,19 @@ class Bundle:
         return self.buckets[-1]["batch"]
 
     def bucket_for(self, rows):
-        """The smallest exported bucket holding ``rows`` rows."""
-        for b in self.buckets:
-            if b["batch"] >= rows:
-                return b
-        raise ValueError(
-            "batch of %d rows exceeds the largest exported bucket (%d); "
-            "re-export with a larger batch size or split the request"
-            % (rows, self.max_batch()))
+        """The smallest exported bucket holding ``rows`` rows — THE
+        bucket-choice rule, shared with training-side length bucketing
+        (paddle_tpu.data.bucketing.bucket_index; agreement pinned by
+        tests/test_data_pipeline.py)."""
+        from paddle_tpu.data.bucketing import bucket_index
+
+        try:
+            return self.buckets[bucket_index(rows, self.batch_sizes())]
+        except ValueError:
+            raise ValueError(
+                "batch of %d rows exceeds the largest exported bucket (%d); "
+                "re-export with a larger batch size or split the request"
+                % (rows, self.max_batch()))
 
     def feed_shape(self, spec, batch):
         """Shape of one flat feed array (the data array for sequence

@@ -184,9 +184,15 @@ class Topology:
                 # layer-stack context on failure (reference: CustomStackTrace
                 # gLayerStackTrace, NeuralNetwork.cpp:244-251 — crashes name
                 # the offending layer)
-                exc.add_note("  in layer %r (type %s), inputs: %s" % (
+                note = "  in layer %r (type %s), inputs: %s" % (
                     node.name, node.layer_type,
-                    [p.name for p in node.inputs]))
+                    [p.name for p in node.inputs])
+                if hasattr(exc, "add_note"):  # PEP 678, python >= 3.11
+                    exc.add_note(note)
+                elif exc.args and isinstance(exc.args[0], str):
+                    exc.args = (exc.args[0] + "\n" + note,) + exc.args[1:]
+                else:
+                    exc.args = exc.args + (note,)
                 raise
         return values
 
@@ -225,7 +231,7 @@ class Topology:
         return self._data_types
 
 
-def convert_feed(topology, data_batch, feeding=None):
+def convert_feed(topology, data_batch, feeding=None, max_len=None):
     """Convert a host minibatch (list of tuples, v2 reader convention) into
     device-ready feed values according to each data layer's InputType.
 
@@ -236,6 +242,11 @@ def convert_feed(topology, data_batch, feeding=None):
     ``sparse_feed_threshold`` dims and feed as :class:`SparseRows` (padded
     id lists; fc consumes them via gather/weighted-sum) at or above it —
     the reference's million-dim sparse FC capability.
+
+    ``max_len`` (length-bucketed batching, paddle_tpu.data.bucketing):
+    pad single-level sequence slots to exactly this width instead of the
+    batch-max bucket — one jit cache entry per bucket. Default None is
+    the historical behavior, bit for bit.
     """
     names = [name for name, _ in topology.data_types()]
     if feeding is None:
@@ -249,11 +260,11 @@ def convert_feed(topology, data_batch, feeding=None):
                 "sample tuple of length %d has no column %d for data layer %r "
                 "(feeding=%r)", len(row), idx, name, feeding)
         col = [row[idx] for row in data_batch]
-        feed[name] = convert_column(col, itype)
+        feed[name] = convert_column(col, itype, max_len=max_len)
     return feed
 
 
-def convert_column(col, itype):
+def convert_column(col, itype, max_len=None):
     if itype.seq_type == SEQ_NONE:
         if itype.value_type == DENSE:
             return jnp.asarray(np.asarray(col, dtype=np.float32))
@@ -277,7 +288,7 @@ def convert_column(col, itype):
             seqs = [np.asarray(s, dtype=np.int32) for s in col]
         else:
             seqs = [_densify(s, itype) for s in col]
-        return SequenceBatch.from_sequences(seqs)
+        return SequenceBatch.from_sequences(seqs, max_len=max_len)
     elif itype.seq_type == SEQ_NESTED:
         if itype.value_type == DENSE:
             nested = [[np.asarray(s, dtype=np.float32) for s in subs] for subs in col]

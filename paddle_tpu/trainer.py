@@ -190,14 +190,45 @@ class SGD:
 
     # -- main loop ----------------------------------------------------------
     def train(self, reader, num_passes=1, event_handler=None, feeding=None,
-              sync_params=True, test_reader=None):
+              sync_params=True, test_reader=None, feed_pipeline=False,
+              buckets=None):
         """Event-driven training (v2 SGD.train parity). ``reader`` yields
         minibatches (lists of sample tuples). With ``test_reader`` and a
         nonzero ``test_period`` flag, an evaluation pass runs every N
-        batches (reference: Tester::testOnePeriod, --test_period)."""
+        batches (reference: Tester::testOnePeriod, --test_period).
+
+        ``feed_pipeline`` (paddle_tpu.data, docs/data.md): move batch
+        conversion + device placement onto a background thread that keeps
+        N batches device-resident ahead of the step (True = depth 2, or
+        an int depth) — PyDataProvider2's pool-thread double buffering,
+        TPU-shaped. Off (default) is byte-identical to the historical
+        synchronous feed; on, the fixed-seed loss trajectory is identical
+        (tests/test_data_pipeline.py) and the steplog gains ``feed``
+        records plus a ``paddle_tpu_data_feed_stall_ms`` histogram.
+
+        ``buckets``: regroup the minibatch stream by sequence length
+        (True = auto-derive boundaries from the observed distribution, or
+        an explicit ascending list) so each batch pads only to its bucket
+        — one jit cache entry per bucket (data/bucketing.py). Partial
+        batches flush at end of pass with their own row counts (extra jit
+        entries when pass-to-pass leftovers vary, e.g. under shuffling);
+        pass the dict form ``buckets={"boundaries": [...],
+        "drop_remainder": True}`` to drop them instead.
+        """
         if event_handler is None:
             event_handler = default_event_handler
         feeding = feeding or self.feeding
+        if buckets is not None and buckets is not False:
+            from paddle_tpu.data import bucketing as data_bucketing
+
+            opts = dict(buckets) if isinstance(buckets, dict) else {
+                "boundaries": None if buckets is True else buckets}
+            bounds = opts.get("boundaries")
+            reader = data_bucketing.rebucket_batches(
+                reader, buckets=bounds,
+                drop_remainder=bool(opts.get("drop_remainder", False)),
+                length_of=data_bucketing.topology_length_of(
+                    self.topology, feeding))
         log_period = flags.get_flag("log_period")
         test_period = flags.get_flag("test_period")
 
@@ -229,7 +260,8 @@ class SGD:
         try:
             self._train_passes(reader, num_passes, event_handler, feeding,
                                sync_params, test_reader, log_period,
-                               test_period, slog, last_final, sentinel)
+                               test_period, slog, last_final, sentinel,
+                               feed_pipeline=feed_pipeline)
         except BaseException as exc:
             # any escape from the training loop dumps the black box
             # (a sentinel halt already dumped; on_exception skips it)
@@ -260,9 +292,13 @@ class SGD:
 
     def _train_passes(self, reader, num_passes, event_handler, feeding,
                       sync_params, test_reader, log_period, test_period,
-                      slog, last_final, sentinel=None):
+                      slog, last_final, sentinel=None, feed_pipeline=False):
         (m_steps, m_examples, m_loss,
          m_examples_per_sec) = self._train_metrics()
+        # ONE feeder across passes (batches() starts a fresh producer
+        # thread per pass) so its cumulative per-bucket fill/waste
+        # gauges span the whole run, like the serve engine's
+        feeder = None
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             eval_acc = {e.name: None for e in self.evaluators}
@@ -333,22 +369,63 @@ class SGD:
                     pass_id, b_id, float(loss), metrics))
 
             self._pass_step_base = self._step_count
-            for data_batch in reader():
-                event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                with observe_spans.span("feed") as feed_scope:
-                    feed = convert_feed(self.topology, data_batch, feeding)
-                self._rng, step_rng = jax.random.split(self._rng)
-                with observe_spans.span("train_step"):
-                    (loss, self._trainable, self._replica, self._state,
-                     self._opt_state, stats) = self._train_step(
-                        self._trainable, self._replica, self._static,
-                        self._state, self._opt_state, feed, step_rng)
-                self._step_count += 1
-                if pending is not None:
-                    finalize(pending)
-                pending = (batch_id, loss, stats, feed,
-                           feed_scope.dur * 1000.0, len(data_batch))
-                batch_id += 1
+            if not feed_pipeline:
+                for data_batch in reader():
+                    event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                    with observe_spans.span("feed") as feed_scope:
+                        feed = convert_feed(
+                            self.topology, data_batch, feeding,
+                            max_len=getattr(data_batch, "bucket", None))
+                    self._rng, step_rng = jax.random.split(self._rng)
+                    with observe_spans.span("train_step"):
+                        (loss, self._trainable, self._replica, self._state,
+                         self._opt_state, stats) = self._train_step(
+                            self._trainable, self._replica, self._static,
+                            self._state, self._opt_state, feed, step_rng)
+                    self._step_count += 1
+                    if pending is not None:
+                        finalize(pending)
+                    pending = (batch_id, loss, stats, feed,
+                               feed_scope.dur * 1000.0, len(data_batch))
+                    batch_id += 1
+            else:
+                # pipelined feed (paddle_tpu.data.feeder): conversion +
+                # device placement happen on the feeder's producer thread;
+                # the "feed" span here measures only the STALL the step
+                # thread spent waiting for data (that stall is also a
+                # paddle_tpu_data_feed_stall_ms histogram sample, and each
+                # batch writes a ``feed`` steplog record). feed_ms on the
+                # step record = the stall, the host time actually charged
+                # to the step thread.
+                from paddle_tpu.data.feeder import DeviceFeeder
+
+                depth = 2 if feed_pipeline is True \
+                    else max(int(feed_pipeline), 1)
+                if feeder is None:
+                    feeder = DeviceFeeder(reader, self.topology,
+                                          feeding=feeding, depth=depth,
+                                          parallelism=self.parallelism)
+                for fb in feeder.batches():
+                    event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                    self._rng, step_rng = jax.random.split(self._rng)
+                    with observe_spans.span("train_step"):
+                        (loss, self._trainable, self._replica, self._state,
+                         self._opt_state, stats) = self._train_step(
+                            self._trainable, self._replica, self._static,
+                            self._state, self._opt_state, fb.feed, step_rng)
+                    self._step_count += 1
+                    if slog is not None:
+                        slog.log_feed(
+                            step=self._step_count, stall_ms=fb.stall_ms,
+                            convert_ms=fb.convert_ms, examples=fb.examples,
+                            depth=depth, bucket=fb.bucket,
+                            fill_tokens=fb.fill_tokens,
+                            pad_tokens=fb.pad_tokens)
+                    if pending is not None:
+                        finalize(pending)
+                    pending = (batch_id, loss, stats, fb.feed,
+                               fb.stall_ms, fb.examples)
+                    batch_id += 1
             if pending is not None:
                 finalize(pending)
             if test_reader is not None and not test_period:

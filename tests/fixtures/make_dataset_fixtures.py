@@ -14,6 +14,7 @@ import io
 import os
 import pickle
 import tarfile
+import zipfile
 
 import numpy as np
 
@@ -136,12 +137,60 @@ def make_uci_housing(path, rows=10):
             f.write(" ".join("%9.4f" % v for v in row) + "\n")
 
 
+def make_movielens(path):
+    """A 3-user / 4-movie / 10-rating ml-1m.zip in the REAL GroupLens
+    layout (:: separators, title years, pipe-joined genres)."""
+    users = (
+        "1::F::1::10::48067\n"
+        "2::M::56::16::70072\n"
+        "3::M::25::15::55117\n")
+    movies = (
+        "1::Toy Story (1995)::Animation|Children's|Comedy\n"
+        "2::Jumanji (1995)::Adventure|Children's|Fantasy\n"
+        "3::Heat (1995)::Action|Crime|Thriller\n"
+        "4::Toy Story 2 (1999)::Animation|Children's|Comedy\n")
+    # 41 deterministic rating lines: the reference's seeded split
+    # (random.Random(0).random() < 0.1 per line) puts line indices 35
+    # and 40 in the TEST split, so both readers are exercised
+    lines = []
+    for i in range(41):
+        u, m = i % 3 + 1, i % 4 + 1
+        lines.append("%d::%d::%d::%d\n"
+                     % (u, m, 1 + (u * 31 + m * 17) % 5, 978300000 + i))
+    ratings = "".join(lines)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        for name, text in (("ml-1m/users.dat", users),
+                           ("ml-1m/movies.dat", movies),
+                           ("ml-1m/ratings.dat", ratings)):
+            info = zipfile.ZipInfo(name, date_time=(1980, 1, 1, 0, 0, 0))
+            zf.writestr(info, text)
+
+
+def make_imikolov(path):
+    """A 5-sentence train / 2-sentence valid simple-examples.tgz in the
+    REAL PTB member layout (one sentence per line)."""
+    train = ("the cat sat on the mat\n"
+             "the dog sat on the log\n"
+             "a cat and a dog\n"
+             "the cat saw the dog\n"
+             "no <unk> here\n")
+    valid = ("the cat sat\n"
+             "a dog ran\n")
+    with tarfile.open(path, "w:gz") as tar:
+        _add_bytes(tar, "./simple-examples/data/ptb.train.txt",
+                   train.encode())
+        _add_bytes(tar, "./simple-examples/data/ptb.valid.txt",
+                   valid.encode())
+
+
 def main():
     make_imdb(os.path.join(HERE, "aclImdb_v1.tar.gz"))
     make_cifar10(os.path.join(HERE, "cifar-10-python.tar.gz"))
     make_conll05(os.path.join(HERE, "conll05st-tests.tar.gz"), HERE)
     make_wmt14(os.path.join(HERE, "wmt14.tgz"))
     make_uci_housing(os.path.join(HERE, "housing.data"))
+    make_movielens(os.path.join(HERE, "ml-1m.zip"))
+    make_imikolov(os.path.join(HERE, "simple-examples.tgz"))
     print("fixtures written to", HERE)
 
 

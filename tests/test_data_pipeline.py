@@ -1,0 +1,578 @@
+"""paddle_tpu.data tests: bucket-choice agreement with serving, length
+bucketing, sequence packing (gradient-match vs the unpacked baseline),
+the DeviceFeeder pipeline (parity, cancellation, error propagation) and
+the trainer wiring (fixed-seed loss-trajectory equivalence, feed
+telemetry records)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import data_type as dt, layer as L, minibatch
+from paddle_tpu import optimizer as opt
+from paddle_tpu.core.sequence import PackedSequenceBatch, SequenceBatch
+from paddle_tpu.data import bucketing
+from paddle_tpu.data.feeder import DeviceFeeder
+from paddle_tpu.graph import reset_name_counters
+from paddle_tpu.observe import metrics as observe_metrics
+from paddle_tpu.observe import steplog
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.topology import Topology, convert_feed
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden",
+                      "steplog_schema.json")
+
+
+# ---- bucket choice ---------------------------------------------------------
+
+def test_bucket_index_semantics():
+    sizes = [4, 8, 32]
+    assert bucketing.bucket_for(1, sizes) == 4
+    assert bucketing.bucket_for(4, sizes) == 4
+    assert bucketing.bucket_for(5, sizes) == 8
+    assert bucketing.bucket_for(32, sizes) == 32
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        bucketing.bucket_index(33, sizes)
+
+
+def test_serve_bundle_bucket_choice_agrees_with_training():
+    """THE dedup satellite: the serving bundle's bucket_for and the
+    training-side bucket choice are ONE function — pin agreement over
+    every reachable row count so serving and training can never drift."""
+    from paddle_tpu.serve.bundle import Bundle
+
+    bundle = Bundle.__new__(Bundle)
+    bundle.buckets = [{"batch": 1}, {"batch": 8}, {"batch": 32}]
+    sizes = bundle.batch_sizes()
+    for rows in range(1, 33):
+        assert bundle.bucket_for(rows)["batch"] == \
+            bucketing.bucket_for(rows, sizes)
+    with pytest.raises(ValueError, match="largest exported bucket"):
+        bundle.bucket_for(33)
+
+
+def test_derive_buckets_bounded_and_covering():
+    rng = np.random.RandomState(0)
+    lengths = np.clip(rng.lognormal(2.5, 0.8, size=500).astype(int), 1, None)
+    bounds = bucketing.derive_buckets(lengths, max_buckets=6)
+    assert 1 <= len(bounds) <= 6
+    assert bounds == sorted(bounds)
+    assert all(b % 8 == 0 for b in bounds)
+    assert bounds[-1] >= lengths.max()  # every observed length fits
+
+
+# ---- length bucketing ------------------------------------------------------
+
+def _seq_samples(n, seed=0, vocab=20, labels=4,
+                 lengths=(2, 3, 4, 9, 10, 18)):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ln = int(rng.choice(lengths))
+        out.append((rng.randint(0, vocab, ln).astype(np.int32).tolist(),
+                    rng.randint(0, labels, ln).astype(np.int32).tolist()))
+    return out
+
+
+def test_rebucket_batches_groups_without_loss():
+    samples = _seq_samples(48)
+    base = minibatch.batch(lambda: iter(samples), 8)
+    bounds = [4, 10, 20]
+    batches = list(bucketing.rebucket_batches(base, buckets=bounds)())
+    got = [tuple(map(tuple, s)) for b in batches for s in b]
+    want = [tuple(map(tuple, s)) for s in samples]
+    assert sorted(got) == sorted(want)  # nothing lost or duplicated
+    for b in batches:
+        assert isinstance(b, bucketing.BucketBatch)
+        assert b.bucket in bounds
+        for s in b:
+            n = len(s[0])
+            # every sample in its smallest covering bucket
+            assert bucketing.bucket_for(n, bounds) == b.bucket
+
+
+def test_rebucket_drop_remainder():
+    samples = _seq_samples(50)
+    base = minibatch.batch(lambda: iter(samples), 8)
+    batches = list(bucketing.rebucket_batches(
+        base, buckets=[4, 10, 20], drop_remainder=True)())
+    assert batches and all(len(b) == 8 for b in batches)  # only full
+
+
+def test_rebucket_batches_auto_derives():
+    samples = _seq_samples(60, seed=3)
+    base = minibatch.batch(lambda: iter(samples), 8)
+    batches = list(bucketing.rebucket_batches(
+        base, buckets=None, sample_window=16)())
+    assert sum(len(b) for b in batches) == 56  # 60 rounded to batches of 8
+    buckets = {b.bucket for b in batches}
+    assert len(buckets) > 1  # skewed lengths actually split
+
+
+def test_bucketed_convert_pads_to_exact_bucket():
+    """One jit cache entry per bucket: conversion pads sequence slots to
+    exactly the batch's bucket boundary, not the batch max."""
+    reset_name_counters()
+    word = L.data(name="word", type=dt.integer_value_sequence(20))
+    label = L.data(name="label", type=dt.integer_value_sequence(4))
+    cost = L.classification_cost(
+        input=L.fc(input=L.embedding(input=word, size=4), size=4),
+        label=label)
+    topo = Topology(cost)
+    batch = bucketing.BucketBatch(_seq_samples(4, lengths=(2, 3)), 10)
+    feed = convert_feed(topo, batch, max_len=batch.bucket)
+    assert feed["word"].max_len == 10
+    assert feed["label"].max_len == 10
+    # default (no max_len) keeps the historical behavior: batch max
+    # rounded up the global bucket_length table (here 3 -> 16)
+    feed_plain = convert_feed(topo, list(batch))
+    assert feed_plain["word"].max_len == 16
+
+
+def test_topology_length_of_ignores_dense_columns():
+    """Mixed schema (dense feature vector + sequence): the bucket key
+    must come from the SEQUENCE slots, not the fixed feature width —
+    the trainer's buckets= wiring uses topology_length_of for this."""
+    reset_name_counters()
+    feats = L.data(name="feats", type=dt.dense_vector(128))
+    word = L.data(name="word", type=dt.integer_value_sequence(20))
+    merged = L.fc(input=[L.embedding(input=word, size=4),
+                         L.expand(input=L.fc(input=feats, size=4),
+                                  expand_as=word)], size=4)
+    label = L.data(name="label", type=dt.integer_value_sequence(4))
+    cost = L.classification_cost(input=merged, label=label)
+    topo = Topology(cost)
+    length_of = bucketing.topology_length_of(topo)
+    sample = (np.zeros(128, np.float32), [1, 2, 3], [0, 1, 2])
+    assert length_of(sample) == 3  # not 128
+    assert bucketing.default_length_of(sample) == 128  # the caveat
+
+
+def test_batch_waste_accounting():
+    samples = [([1, 2], [0, 1]), ([1, 2, 3, 4], [0, 1, 2, 3])]
+    fill, pad = bucketing.batch_waste(samples, padded_len=8)
+    assert fill == 6 and pad == 2 * 8 - 6
+
+
+# ---- packing ---------------------------------------------------------------
+
+def test_pack_samples_respects_budget():
+    samples = _seq_samples(30, seed=1)
+    rows = bucketing.pack_samples(samples, max_len=20)
+    flat = [tuple(map(tuple, s)) for r in rows for s in r]
+    assert sorted(flat) == sorted(tuple(map(tuple, s)) for s in samples)
+    for row in rows:
+        assert sum(len(s[0]) for s in row) <= 20
+    # packing actually packs: fewer rows than samples
+    assert len(rows) < len(samples)
+
+
+def test_packed_batches_reader():
+    samples = _seq_samples(40, seed=2)
+    reader = bucketing.packed_batches(
+        lambda: iter(samples), batch_size=4, max_len=20)
+    batches = list(reader())
+    flat = [tuple(map(tuple, s)) for b in batches for row in b for s in row]
+    assert sorted(flat) == sorted(tuple(map(tuple, s)) for s in samples)
+    assert all(len(b) <= 4 for b in batches)
+
+
+def test_packed_batches_streams_with_bounded_open_set():
+    """The first-fit open set is capped: a long stream whose rows never
+    fill exactly must still yield batches WHILE streaming (not buffer
+    everything to end-of-stream) and lose no samples."""
+    samples = [([1] * 5, [0] * 5) for _ in range(400)]  # 5 never sums to 64
+    reader = bucketing.packed_batches(lambda: iter(samples), batch_size=4,
+                                      max_len=64, max_open_rows=8)
+    it = reader()
+    first = next(it)  # arrives mid-stream thanks to the cap
+    rest = list(it)
+    total = sum(len(s[0]) for b in [first] + rest for row in b for s in row)
+    assert total == 400 * 5
+    for b in [first] + rest:
+        for row in b:
+            assert sum(len(s[0]) for s in row) <= 64
+
+
+def _tagging_model(vocab=30, labels=5, hidden=8, bidirectional=False):
+    reset_name_counters()
+    word = L.data(name="word", type=dt.integer_value_sequence(vocab))
+    emb = L.embedding(input=word, size=6)
+    proj = L.fc(input=emb, size=3 * hidden)
+    fwd = L.grumemory(input=proj, size=hidden)
+    feat = fwd
+    if bidirectional:
+        bwd = L.grumemory(input=proj, size=hidden, reverse=True)
+        feat = L.concat(input=[fwd, bwd])
+    scores = L.fc(input=feat, size=labels)
+    label = L.data(name="label", type=dt.integer_value_sequence(labels))
+    cost = L.classification_cost(input=scores, label=label)
+    return cost
+
+
+def test_pack_feed_segment_layout():
+    cost = _tagging_model()
+    topo = Topology(cost)
+    samples = [([1, 2, 3], [0, 1, 2]), ([4, 5], [3, 4]), ([6], [0])]
+    rows = bucketing.pack_samples(samples, max_len=8)
+    feed = bucketing.pack_feed(topo, rows, max_len=8)
+    word = feed["word"]
+    assert isinstance(word, PackedSequenceBatch)
+    data = np.asarray(word.data)
+    seg = np.asarray(word.segments)
+    lens = np.asarray(word.lengths)
+    # one row: [1,2,3 | 4,5 | 6] with segments [0,0,0,1,1,2]
+    assert lens[0] == 6
+    np.testing.assert_array_equal(data[0, :6], [1, 2, 3, 4, 5, 6])
+    np.testing.assert_array_equal(seg[0, :6], [0, 0, 0, 1, 1, 2])
+    np.testing.assert_array_equal(seg[0, 6:], [-1, -1])
+    # reset mask fires exactly at segment starts
+    reset = np.asarray(word.reset_mask())
+    np.testing.assert_array_equal(
+        reset[0], [True, False, False, True, False, True, False, False])
+
+
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_packing_gradient_match(bidirectional):
+    """THE packing acceptance test: packed-with-segment-mask cost and
+    gradients equal the unpacked baseline (atol <= 1e-5) on a small GRU
+    tagging config — forward-only AND bi-directional (per-segment
+    reverse)."""
+    cost = _tagging_model(bidirectional=bidirectional)
+    topo = Topology(cost)
+    params_obj = Parameters.create(cost)
+    params = {n: jnp.asarray(params_obj.get(n))
+              for n in params_obj.names()}
+    rng = np.random.RandomState(0)
+    samples = []
+    for n in (3, 5, 2, 7, 4, 6, 1, 4):
+        samples.append((rng.randint(0, 30, n).astype(np.int32).tolist(),
+                        rng.randint(0, 5, n).astype(np.int32).tolist()))
+
+    def cost_sum(p, feed):
+        values, _ = topo.apply(p, feed, mode="test")
+        return jnp.sum(values[cost.name])
+
+    feed_u = convert_feed(topo, samples)
+    cu, gu = jax.value_and_grad(cost_sum)(params, feed_u)
+    rows = bucketing.pack_samples(samples, max_len=16)
+    assert len(rows) < len(samples)
+    feed_p = bucketing.pack_feed(topo, rows, max_len=16)
+    cp, gp = jax.value_and_grad(cost_sum)(params, feed_p)
+    np.testing.assert_allclose(float(cu), float(cp), atol=1e-5)
+    for name in gu:
+        np.testing.assert_allclose(np.asarray(gu[name]),
+                                   np.asarray(gp[name]), atol=1e-5,
+                                   err_msg=name)
+
+
+def test_pack_feed_pads_overlong_own_row_sample():
+    """pack_samples gives an overlong sample its own row ('pad, never
+    truncate'); pack_feed must widen the batch to fit it, not raise."""
+    cost = _tagging_model()
+    topo = Topology(cost)
+    long = (list(range(1, 21)), [0] * 20)  # length 20 > max_len 16
+    samples = [([1, 2], [0, 1]), long, ([3], [2])]
+    rows = bucketing.pack_samples(samples, max_len=16)
+    assert [len(s[0]) for r in rows for s in r].count(20) == 1
+    feed = bucketing.pack_feed(topo, rows, max_len=16)
+    assert feed["word"].max_len >= 20  # widened, nothing truncated
+    lens = np.asarray(feed["word"].lengths)
+    assert lens.max() == 20
+
+
+def test_rebucket_top_bucket_grows_geometrically():
+    """Samples longer than every bucket widen the list GEOMETRICALLY —
+    a length-sorted stream must not mint one jit shape per new record
+    length."""
+    samples = [([1] * n, [0] * n) for n in range(1, 65)]  # sorted lengths
+    base = minibatch.batch(lambda: iter(samples), 4)
+    batches = list(bucketing.rebucket_batches(base, buckets=[4])())
+    buckets = sorted({b.bucket for b in batches})
+    assert buckets == [4, 16, 32, 64]  # log growth, not per-length
+    got = sorted(len(s[0]) for b in batches for s in b)
+    assert got == sorted(len(s[0]) for s in samples)
+
+
+def test_reduction_layers_reject_packed_input():
+    """pooling/last_seq would silently collapse packed neighbours into
+    one output — they must refuse packed batches like crf does."""
+    from paddle_tpu.pooling import AvgPooling
+
+    reset_name_counters()
+    word = L.data(name="word", type=dt.integer_value_sequence(20))
+    pooled = L.pooling(input=L.embedding(input=word, size=4),
+                       pooling_type=AvgPooling())
+    score = L.fc(input=pooled, size=1)
+    label = L.data(name="label", type=dt.integer_value_sequence(2))
+    cost = L.square_error_cost(
+        input=score, label=L.fc(input=L.pooling(
+            input=L.embedding(input=label, size=1),
+            pooling_type=AvgPooling()), size=1))
+    topo = Topology(cost)
+    samples = [([1, 2], [0, 1]), ([3], [1])]
+    feed = bucketing.pack_feed(topo, bucketing.pack_samples(samples, 8),
+                               max_len=8)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    with pytest.raises(Exception, match="packed"):
+        topo.apply(params, feed, mode="test")
+
+
+def test_crf_rejects_packed_input():
+    """Chain transitions would silently bridge packed neighbours — the
+    crf layer refuses packed batches at trace time."""
+    from paddle_tpu.models import text
+
+    reset_name_counters()
+    scores = text.sequence_tagging_rnn(word_dict_size=20, label_dict_size=4,
+                                       emb_size=4, hidden=4)
+    label = L.data(name="label", type=dt.integer_value_sequence(4))
+    cost = L.crf(input=scores, label=label, name="packed_crf")
+    topo = Topology(cost)
+    samples = [([1, 2], [0, 1]), ([3], [2])]
+    feed = bucketing.pack_feed(topo, bucketing.pack_samples(samples, 8),
+                               max_len=8)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    with pytest.raises(Exception, match="packed"):
+        topo.apply(params, feed, mode="test")
+
+
+# ---- DeviceFeeder ----------------------------------------------------------
+
+def _dense_model():
+    reset_name_counters()
+    x = L.data(name="x", type=dt.dense_vector(6))
+    y = L.data(name="y", type=dt.dense_vector(1))
+    out = L.fc(input=L.fc(input=x, size=6), size=1)
+    return L.square_error_cost(input=out, label=y)
+
+
+def _dense_batches(n_batches, batch=4, seed=0):
+    rng = np.random.RandomState(seed)
+    data = []
+    for _ in range(n_batches):
+        data.append([(rng.randn(6).astype(np.float32),
+                      np.array([rng.randn()], np.float32))
+                     for _ in range(batch)])
+    return data
+
+
+def test_feeder_matches_sync_conversion():
+    cost = _dense_model()
+    topo = Topology(cost)
+    batches = _dense_batches(4)
+    reg = observe_metrics.MetricsRegistry()
+    feeder = DeviceFeeder(lambda: iter(batches), topo, depth=2,
+                          metrics_registry=reg)
+    got = list(feeder.batches())
+    assert len(got) == 4
+    for fb, batch in zip(got, batches):
+        want = convert_feed(topo, batch)
+        for key in want:
+            np.testing.assert_array_equal(np.asarray(fb.feed[key]),
+                                          np.asarray(want[key]))
+        assert fb.examples == len(batch)
+        assert fb.stall_ms is not None and fb.convert_ms is not None
+    snap = reg.snapshot()
+    assert snap["counters"]["paddle_tpu_data_batches_total"] == 4
+    assert snap["histograms"][
+        "paddle_tpu_data_feed_stall_ms"]["count"] == 4
+
+
+def test_feeder_propagates_reader_error():
+    cost = _dense_model()
+    topo = Topology(cost)
+    batches = _dense_batches(2)
+
+    def bad_reader():
+        yield batches[0]
+        raise RuntimeError("reader exploded")
+
+    feeder = DeviceFeeder(bad_reader, topo,
+                          metrics_registry=observe_metrics.MetricsRegistry())
+    it = feeder.batches()
+    next(it)
+    with pytest.raises(RuntimeError, match="reader exploded"):
+        list(it)
+    _assert_feeder_threads_exit()
+
+
+def _assert_feeder_threads_exit(timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "data-feeder-producer" and t.is_alive()]
+        if not alive:
+            return
+        time.sleep(0.02)
+    raise AssertionError("feeder producer thread leaked")
+
+
+def test_feeder_abandoned_consumer_cancels_producer():
+    """Break out of the batch loop after one item: the producer thread
+    must exit even though the queue was full (clean cancellation)."""
+    cost = _dense_model()
+    topo = Topology(cost)
+    batches = _dense_batches(200)
+    feeder = DeviceFeeder(lambda: iter(batches), topo, depth=1,
+                          metrics_registry=observe_metrics.MetricsRegistry())
+    it = feeder.batches()
+    next(it)
+    it.close()
+    _assert_feeder_threads_exit()
+
+
+def test_feeder_bucket_gauges():
+    cost = _tagging_model()
+    topo = Topology(cost)
+    samples = _seq_samples(16, lengths=(2, 3))
+    base = minibatch.batch(lambda: iter(samples), 4)
+    bucketed = bucketing.rebucket_batches(base, buckets=[4, 8])
+    reg = observe_metrics.MetricsRegistry()
+    feeder = DeviceFeeder(bucketed, topo, metrics_registry=reg)
+    seen = list(feeder.batches())
+    assert seen and all(fb.bucket == 4 for fb in seen)
+    snap = reg.snapshot()
+    fill = snap["gauges"]['paddle_tpu_data_bucket_fill_ratio{bucket="4"}']
+    waste = snap["gauges"][
+        'paddle_tpu_data_padding_waste_ratio{bucket="4"}']
+    assert fill + waste == pytest.approx(1.0)
+    assert 0.0 < waste < 1.0
+
+
+def test_feeder_sharding_aware_with_dataparallel():
+    """With a DataParallel plan the producer thread applies the
+    global-mesh batch placement itself (device_put onto the 'data'
+    axis), so the transfer happens ahead of the step."""
+    from paddle_tpu.parallel.mesh import DataParallel, build_mesh
+
+    mesh = build_mesh({"data": jax.device_count()})
+    dp = DataParallel(mesh)
+    cost = _dense_model()
+    topo = Topology(cost)
+    batches = _dense_batches(2, batch=8)
+    feeder = DeviceFeeder(lambda: iter(batches), topo, parallelism=dp,
+                          metrics_registry=observe_metrics.MetricsRegistry())
+    fbs = list(feeder.batches())
+    assert len(fbs) == 2
+    x = fbs[0].feed["x"]
+    assert x.sharding.spec[0] == "data"  # batch axis sharded on the mesh
+    assert not x.sharding.is_fully_replicated
+
+
+def test_pipelined_dataparallel_matches_sync():
+    from paddle_tpu.parallel.mesh import DataParallel, build_mesh
+
+    def run(feed_pipeline):
+        mesh = build_mesh({"data": jax.device_count()})
+        cost = _dense_model()
+        params = Parameters.create(cost)
+        trainer = paddle.trainer.SGD(
+            cost, params, opt.Momentum(learning_rate=1e-2, momentum=0.9),
+            parallelism=DataParallel(mesh))
+        batches = _dense_batches(3, batch=8, seed=11)
+        losses = []
+        trainer.train(lambda: iter(batches), num_passes=2,
+                      event_handler=lambda e: losses.append(e.cost)
+                      if isinstance(e, paddle.event.EndIteration) else None,
+                      feed_pipeline=feed_pipeline)
+        return losses
+
+    assert run(False) == run(True)
+
+
+# ---- trainer wiring --------------------------------------------------------
+
+def _train_losses(feed_pipeline, num_passes=3, **train_kw):
+    cost = _dense_model()
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost, params, opt.Momentum(learning_rate=1e-2, momentum=0.9))
+    batches = _dense_batches(3, seed=7)
+    losses = []
+    trainer.train(
+        lambda: iter(batches), num_passes=num_passes,
+        event_handler=lambda e: losses.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None,
+        feed_pipeline=feed_pipeline, **train_kw)
+    return losses
+
+
+def test_pipelined_feed_identical_loss_trajectory():
+    """THE pipeline acceptance test: fixed-seed loss trajectory of the
+    pipelined feed is IDENTICAL (not just close) to the sync feed."""
+    sync = _train_losses(False)
+    piped = _train_losses(True)
+    assert len(sync) == 9
+    assert sync == piped
+
+
+def test_pipelined_feed_depth_int():
+    assert _train_losses(3) == _train_losses(False)
+
+
+def test_bucketed_training_trains_and_bounds_shapes():
+    cost = _tagging_model()
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Adam(learning_rate=1e-2))
+    samples = _seq_samples(32, seed=9)
+    losses = []
+    trainer.train(
+        minibatch.batch(lambda: iter(samples), 8), num_passes=2,
+        event_handler=lambda e: losses.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None,
+        feed_pipeline=True, buckets=[4, 10, 20])
+    assert losses and all(np.isfinite(losses))
+    _assert_feeder_threads_exit()
+
+
+def test_trainer_feed_records_and_summary(tmp_path, monkeypatch):
+    """Pipelined training under telemetry writes schema-valid ``feed``
+    records, and summarize_dir/cli observe surface the stall
+    percentiles."""
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY", str(tmp_path))
+    _train_losses(True, num_passes=2)
+    path = next(p for p in os.listdir(str(tmp_path))
+                if p.endswith(".steps.jsonl"))
+    records = steplog.read_jsonl(os.path.join(str(tmp_path), path))
+    feeds = [r for r in records if r["type"] == "feed"]
+    assert len(feeds) == 6  # 2 passes x 3 batches
+    golden = json.load(open(GOLDEN))
+    spec = golden["record_types"]["feed"]
+    for rec in feeds:
+        assert not set(spec["required"]) - set(rec)
+        assert not (set(rec) - set(spec["required"])
+                    - set(spec["optional"]))
+        assert rec["depth"] == 2 and rec["examples"] == 4
+    steps = [r for r in records if r["type"] == "step"]
+    # step records carry the stall as feed_ms and pair 1:1 with feeds
+    assert len(steps) == 6
+    summary = steplog.summarize_dir(str(tmp_path))
+    run = summary["runs"][0]
+    assert run["feed_batches"] == 6
+    assert "feed_stall_ms_p50" in run and "feed_stall_ms_p95" in run
+
+    from paddle_tpu import cli
+
+    class A:
+        directory = str(tmp_path)
+        regress = None
+        regress_tol = 10.0
+        json = False
+
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.cmd_observe(A()) == 0
+    assert "feed stall ms" in buf.getvalue()

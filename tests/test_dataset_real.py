@@ -256,3 +256,116 @@ def test_uci_housing_synthetic_fallback(data_home):
     x, y = train[0]
     assert x.shape == (13,) and y.shape == (1,)
     assert x.dtype == np.float32 and y.dtype == np.float32
+
+
+# ---- MovieLens -------------------------------------------------------------
+
+def test_movielens_real_parse(data_home):
+    """The REAL ml-1m layout (:: separators, (Year) title suffix,
+    pipe-joined genres): exact meta dicts and exact first-sample ids,
+    and the reference's seeded per-line split — 41 fixture rating lines
+    put exactly indices 35 and 40 in the test split."""
+    from paddle_tpu.dataset import movielens
+
+    _stage(data_home, "movielens", "ml-1m.zip")
+    movielens._meta_cache.clear()
+    movielens._ratings_cache.clear()
+    try:
+        cats = movielens.movie_categories()
+        # genre names sorted for dense ids
+        assert cats["Action"] == 0 and cats["Animation"] == 2
+        titles = movielens.get_movie_title_dict()
+        # years stripped from titles before the word dict
+        assert "Toy" in titles and "(1995)" not in titles
+        assert movielens.max_user_id() == 3
+        assert movielens.max_movie_id() == 4
+        assert movielens.max_job_id() == 16
+
+        train = list(movielens.train()())
+        test = list(movielens.test()())
+        assert len(train) == 39 and len(test) == 2
+        uid, gender, age, job, mid, cat_ids, title_ids, rating = train[0]
+        # line 0: user 1 (F, age 1 -> index 0, job 10), movie 1 Toy Story
+        assert (uid, gender, age, job, mid) == (1, 1, 0, 10, 1)
+        np.testing.assert_array_equal(
+            cat_ids, [cats["Animation"], cats["Children's"],
+                      cats["Comedy"]])
+        np.testing.assert_array_equal(
+            title_ids, [titles["Toy"], titles["Story"]])
+        # rating raw 1..5: line 0 is 1 + (1*31 + 1*17) % 5 = 4
+        assert rating.dtype == np.float32 and rating[0] == 4.0
+        # split index 35: user 3, movie 4, rating 2
+        assert test[0][0] == 3 and test[0][4] == 4
+        assert test[0][7][0] == 2.0
+    finally:
+        movielens._meta_cache.clear()
+        movielens._ratings_cache.clear()
+
+
+def test_movielens_synthetic_fallback(data_home):
+    from paddle_tpu.dataset import movielens
+
+    samples = list(movielens.train(synthetic_size=6)())
+    assert len(samples) == 6
+    assert movielens.max_user_id() == movielens.NUM_USERS
+    uid, gender, age, job, mid, cats, title, rating = samples[0]
+    assert cats.dtype == np.int32 and rating.shape == (1,)
+
+
+# ---- imikolov --------------------------------------------------------------
+
+def test_imikolov_real_parse(data_home):
+    """The REAL PTB member layout: reference dict semantics (per-line
+    <s>/<e> counts, literal <unk> dropped, strict > cutoff, (-freq,
+    word) ordering, <unk> appended last) and exact n-grams."""
+    from paddle_tpu.dataset import imikolov
+
+    _stage(data_home, "imikolov", "simple-examples.tgz")
+    d = imikolov.build_dict(min_word_freq=1)
+    # frequencies count over BOTH splits (reference word_count(test,
+    # word_count(train))): 'the' 6+1, <s>/<e> one per line (5+2) — a
+    # three-way tie at 7 broken by word order; '<unk>' dropped then
+    # appended last
+    assert d["<e>"] == 0 and d["<s>"] == 1 and d["the"] == 2
+    assert d["<unk>"] == len(d) - 1
+    assert d["cat"] == 3 and d["dog"] == 4  # 4 each, tie by word
+    assert "here" not in d  # freq 1 fails the strict > 1 cutoff
+    assert "ran" not in d  # valid-only word, freq 1
+
+    grams = list(imikolov.train(d, 3)())
+    # sentence 1: <s> the cat sat on the mat <e> -> 6 trigrams
+    assert grams[0] == (d["<s>"], d["the"], d["cat"])
+    assert grams[1] == (d["the"], d["cat"], d["sat"])
+    # 'mat' (cutoff-dropped) maps to <unk>
+    assert grams[5] == (d["the"], d["<unk>"], d["<e>"])
+    valid = list(imikolov.test(d, 3)())
+    assert valid[0] == (d["<s>"], d["the"], d["cat"])
+
+
+def test_imikolov_seq_mode(data_home):
+    """mode='seq' (reference DataType.SEQ): whole sentences as
+    (current, next) id lists — variable lengths for bucketing."""
+    from paddle_tpu.dataset import imikolov
+
+    _stage(data_home, "imikolov", "simple-examples.tgz")
+    d = imikolov.build_dict(min_word_freq=1)
+    seqs = list(imikolov.train(d, -1, mode="seq")())
+    assert len(seqs) == 5
+    src, trg = seqs[0]
+    # teacher forcing: trg is src shifted by one, <s> leads, <e> trails
+    assert src[0] == d["<s>"] and trg[-1] == d["<e>"]
+    assert src[1:] == trg[:-1]
+    assert len({len(s) for s, _ in seqs}) > 1  # real length skew
+
+
+def test_imikolov_synthetic_fallback(data_home):
+    from paddle_tpu.dataset import imikolov
+
+    d = imikolov.build_dict()
+    assert len(d) == imikolov.WORD_DICT_SIZE
+    grams = list(imikolov.train(d, 4, synthetic_size=10)())
+    assert len(grams) == 10 and all(len(g) == 4 for g in grams)
+    seqs = list(imikolov.train(d, -1, synthetic_size=50, mode="seq")())
+    lens = [len(s) for s, _ in seqs]
+    assert len(seqs) == 50 and min(lens) >= 1
+    assert len(set(lens)) > 5  # skewed distribution, not one shape

@@ -424,6 +424,8 @@ def _full_featured_log(tmp_path):
         slog.log_step(step=2, wall_ms=3.0)
         slog.write({"type": "event", "event": "compile", "secs": 0.01})
         slog.write({"type": "bench_row", "metric": "x", "value": 1.0})
+        slog.log_feed(step=2, stall_ms=0.8, convert_ms=1.1, examples=64,
+                      depth=2, bucket=32, fill_tokens=100, pad_tokens=28)
         slog.log_serve_request(rows=1, queue_ms=0.5, latency_ms=2.5,
                                req_id=1)
         slog.log_serve_batch(rows=3, bucket=4, infer_ms=1.2, batch_id=1,

@@ -69,6 +69,84 @@ def test_xmap_unordered():
     assert sorted(out) == [2 * i for i in range(20)]
 
 
+# ---- thread-leak regressions (reader/decorator.py cancel machinery) -------
+
+def _reader_threads():
+    import threading
+
+    return [t for t in threading.enumerate()
+            if t.name.startswith(("reader-buffered", "reader-xmap"))]
+
+
+def _assert_reader_threads_exit(timeout=5.0):
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        alive = [t for t in _reader_threads() if t.is_alive()]
+        if not alive:
+            return
+        time.sleep(0.02)
+    raise AssertionError("reader threads leaked: %s"
+                         % [t.name for t in alive])
+
+
+def test_buffered_abandoned_consumer_no_thread_leak():
+    """A consumer that stops early used to leave the fill thread blocked
+    forever on a full queue; closing the generator must cancel it."""
+    it = rd.buffered(_range_reader(10_000), 2)()
+    assert next(it) == 0
+    it.close()
+    _assert_reader_threads_exit()
+
+
+def test_xmap_abandoned_consumer_no_thread_leak():
+    """Same for xmap's feed + worker threads: tiny queues, a huge
+    source, consumer walks away after one item."""
+    it = rd.xmap_readers(lambda x: x, _range_reader(10_000), 3, 2)()
+    next(it)
+    it.close()
+    _assert_reader_threads_exit()
+
+
+def test_xmap_mapper_error_propagates_and_threads_exit():
+    """A raising mapper must surface its error in the consumer AND let
+    every feed/worker thread exit (they used to deadlock on the
+    abandoned queues)."""
+    def bad(x):
+        if x == 5:
+            raise ValueError("mapper boom")
+        return x
+
+    with pytest.raises(ValueError, match="mapper boom"):
+        list(rd.xmap_readers(bad, _range_reader(10_000), 2, 2)())
+    _assert_reader_threads_exit()
+
+
+def test_xmap_source_reader_error_propagates_and_threads_exit():
+    """A raising SOURCE reader (not mapper) must still deliver the
+    worker sentinels: the error surfaces in the consumer instead of
+    hanging it, and every thread exits."""
+    def bad_source():
+        yield 1
+        yield 2
+        raise ValueError("source boom")
+
+    with pytest.raises(ValueError, match="source boom"):
+        list(rd.xmap_readers(lambda x: x, lambda: bad_source(), 2, 4)())
+    _assert_reader_threads_exit()
+
+
+def test_buffered_error_then_threads_exit():
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        list(rd.buffered(lambda: bad(), 2)())
+    _assert_reader_threads_exit()
+
+
 def test_batch():
     out = list(minibatch.batch(_range_reader(7), 3)())
     assert out == [[0, 1, 2], [3, 4, 5]]

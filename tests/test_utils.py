@@ -136,8 +136,10 @@ def test_layer_error_context_names_offending_layer():
     params = topo.init_params(jax.random.PRNGKey(0))
     with pytest.raises(ValueError) as ei:
         topo.apply(params, {"ec_x": np.zeros((2, 4), np.float32)})
-    notes = "".join(getattr(ei.value, "__notes__", []))
-    assert "ec_bad" in notes
+    # python >= 3.11 attaches a PEP 678 note; 3.10 appends to args
+    context = "".join(getattr(ei.value, "__notes__", [])) \
+        + " ".join(str(a) for a in ei.value.args)
+    assert "ec_bad" in context
 
 
 def test_trap_fpe_flag_roundtrip():
