@@ -135,7 +135,8 @@ def cmd_train(args):
             trainer.save_checkpoint(save_dir, pass_id=event.pass_id)
 
     trainer.train(reader, num_passes=args.num_passes, event_handler=handler,
-                  feed_pipeline=getattr(args, "feed_pipeline", 0) or False)
+                  feed_pipeline=getattr(args, "feed_pipeline", 0) or False,
+                  steps_per_call=getattr(args, "steps_per_call", 0) or None)
     if hasattr(cfg, "test_reader"):
         result = trainer.test(minibatch.batch(cfg.test_reader(), batch_size))
         print("test cost=%.6f metrics=%s" % (result.cost, result.metrics))
@@ -479,6 +480,10 @@ def main(argv=None):
     p.add_argument("--feed-pipeline", type=int, default=0,
                    help="pipelined input feed depth (paddle_tpu.data, "
                         "docs/data.md); 0 = synchronous feed")
+    p.add_argument("--steps-per-call", type=int, default=0,
+                   help="fuse K optimizer steps per dispatch (lax.scan "
+                        "with donated carries, docs/data.md); implies "
+                        "the pipelined feed; 0 = one dispatch per step")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("test", parents=[common])

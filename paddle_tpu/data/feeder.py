@@ -46,6 +46,7 @@ import numpy as np
 from paddle_tpu.data.bucketing import BucketBatch, batch_waste
 from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.observe import spans as observe_spans
+from paddle_tpu.utils.logger import logger
 # ONE cancellation handshake for every producer/consumer thread pair in
 # the codebase (poll interval, shutdown ordering): the reader
 # decorators' helpers are reused here, not re-implemented
@@ -82,6 +83,43 @@ class FeedBatch:
         self.bucket = bucket
         self.fill_tokens = fill_tokens
         self.pad_tokens = pad_tokens
+
+
+class ChunkBatch:
+    """K consecutive pipelined batches grouped for one fused dispatch
+    (``trainer.SGD.train steps_per_call=``, docs/data.md).
+
+    ``feed`` is what the trainer hands to the fused step: for
+    ``steps > 1`` a length-K TUPLE of the member device trees
+    (``stacked=True``) — the fused program stacks them into the
+    ``lax.scan`` xs layout inside the jit, so chunk assembly costs the
+    host zero extra dispatches; a single-batch chunk keeps its member's
+    feed untouched (``stacked=False`` — the trainer runs it through the
+    ordinary jitted step, so a K=1 run is the byte-identical program).
+    ``batches`` keeps the member :class:`FeedBatch` records for per-step
+    accounting; ``examples``/``stall_ms``/``convert_ms`` are the chunk
+    totals."""
+
+    __slots__ = ("feed", "steps", "batches", "examples", "stall_ms",
+                 "convert_ms", "stacked")
+
+    def __init__(self, feed, batches, stacked):
+        self.feed = feed
+        self.batches = list(batches)
+        self.steps = len(self.batches)
+        self.stacked = stacked
+        self.examples = sum(fb.examples for fb in self.batches)
+        self.stall_ms = sum(fb.stall_ms or 0.0 for fb in self.batches)
+        self.convert_ms = sum(fb.convert_ms or 0.0 for fb in self.batches)
+
+
+def _feed_shape_key(feed):
+    """Hashable (treedef, leaf shapes/dtypes) key: batches may only share
+    a fused chunk when their feeds compile to the same program."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(feed)
+    return treedef, tuple((tuple(x.shape), str(x.dtype)) for x in leaves)
 
 
 def _seq_stats(feed):
@@ -210,6 +248,72 @@ class DeviceFeeder:
             # wake a producer blocked on a full queue, then let it finish
             _drain(q)
             thread.join(timeout=5.0)
+
+    def chunks(self, k):
+        """Generator of :class:`ChunkBatch` groups of up to ``k``
+        consecutive, shape-compatible batches (the fused-loop feed,
+        ``trainer.SGD.train steps_per_call=``).
+
+        A queue shallower than ``k`` would silently serialize the fused
+        loop — the producer could never stage a full chunk ahead of the
+        step — so the depth is raised to ``k`` up front (loudly, with
+        both numbers). A shape boundary (bucket change, partial final
+        batch) closes the open chunk early: chunks never mix programs,
+        so every chunk lowers to one already-compiled scan shape."""
+        k = int(k)
+        if k < 1:
+            raise ValueError("chunk size must be >= 1, got %d" % k)
+        if k > self.depth:
+            logger.info(
+                "DeviceFeeder queue depth %d is shallower than the fused "
+                "chunk size %d: deepening to %d so a chunk never starves "
+                "the dispatch", self.depth, k, k)
+            self.depth = k
+        group, key = [], None
+        sizes, split = [], 0
+
+        def close(group, was_split=False):
+            nonlocal split
+            split += bool(was_split)
+            sizes.append(len(group))
+            # shape churn (per-batch pad lengths without buckets=) would
+            # close every chunk at size 1 and silently hand back per-step
+            # dispatch — the very overhead steps_per_call exists to kill.
+            # Same loudness rule as the depth mismatch above.
+            if k > 1 and len(sizes) == 8 and split >= 6:
+                logger.warning(
+                    "fused chunks are splitting on shape boundaries "
+                    "(%d of the first %d chunks, avg %.1f of %d steps): "
+                    "consecutive batches rarely share a jit shape — pass "
+                    "buckets= (trainer.SGD.train / docs/data.md) so "
+                    "same-length batches group and chunks actually fuse",
+                    split, len(sizes), sum(sizes) / len(sizes), k)
+            return self._stack_chunk(group)
+
+        for fb in self.batches():
+            fb_key = _feed_shape_key(fb.feed)
+            if group and fb_key != key:
+                yield close(group, was_split=True)
+                group = []
+            key = fb_key
+            group.append(fb)
+            if len(group) == k:
+                yield close(group)
+                group = []
+        if group:
+            yield close(group)
+
+    def _stack_chunk(self, group):
+        """Group K device-resident feeds into one ChunkBatch. The members
+        are already converted and mesh-placed by the producer thread, so
+        grouping is pure bookkeeping — the fused program stacks them
+        inside the jit. Single-batch chunks pass the member feed through
+        untouched so a K=1 (or remainder-1) chunk reuses the plain
+        per-step program."""
+        if len(group) == 1:
+            return ChunkBatch(group[0].feed, group, stacked=False)
+        return ChunkBatch(tuple(fb.feed for fb in group), group,
+                          stacked=True)
 
     def _bucket_gauges(self, fb):
         """Cumulative per-bucket fill/waste — the training twins of the

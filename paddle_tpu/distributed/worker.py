@@ -38,6 +38,12 @@ def main(argv=None):
                          "batches convert and jax.device_put onto the "
                          "GLOBAL data-parallel mesh on a background "
                          "thread, ahead of the step; 0 = synchronous")
+    ap.add_argument("--steps-per-call", type=int, default=0,
+                    help="fuse K optimizer steps per dispatch (one "
+                         "lax.scan over K mesh-sharded feeds with "
+                         "donated carries — composes with the "
+                         "DataParallel global-mesh plan; 0 = one "
+                         "dispatch per step)")
     args = ap.parse_args(argv)
 
     if args.use_tpu:
@@ -71,7 +77,8 @@ def main(argv=None):
     trainer.train(reader, num_passes=args.num_passes,
                   event_handler=lambda e: costs.append(float(e.cost))
                   if getattr(e, "cost", None) is not None else None,
-                  feed_pipeline=args.feed_pipeline or False)
+                  feed_pipeline=args.feed_pipeline or False,
+                  steps_per_call=args.steps_per_call or None)
 
     final = {"process_id": args.process_id,
              "processes": jax.process_count(),

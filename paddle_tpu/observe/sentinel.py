@@ -200,6 +200,50 @@ class Sentinel:
         found = self._check(cost)
         if found is None:
             return None
+        return self._trip(step, cost, found, pass_id=pass_id)
+
+    def record_chunk(self, first_step, costs, pass_id=None, batch_id=None,
+                     **extra):
+        """Chunked readback (trainer ``steps_per_call=K``): ONE ring
+        record for the whole chunk — the fused twin of the per-step ring
+        write :meth:`step` does. Runs no checks; the trainer calls
+        :meth:`check` per in-chunk loss at the same point of its per-step
+        finalize sequence as the legacy path, so halt-mode trips never
+        swallow the records/events of the chunk's pre-anomaly steps."""
+        costs = [None if c is None else float(c) for c in costs]
+        rec = {"step": int(first_step) + max(len(costs) - 1, 0),
+               "chunk_first_step": int(first_step),
+               "chunk_steps": len(costs)}
+        if pass_id is not None:
+            rec["pass"] = int(pass_id)
+        if batch_id is not None:
+            rec["batch"] = int(batch_id)
+        if costs and costs[0] is not None:
+            rec["cost_first"] = (costs[0] if math.isfinite(costs[0])
+                                 else repr(costs[0]))
+        if costs and costs[-1] is not None:
+            rec["cost_last"] = (costs[-1] if math.isfinite(costs[-1])
+                                else repr(costs[-1]))
+        rec.update({k: v for k, v in extra.items() if v is not None})
+        self.recorder.record(rec)
+
+    def check(self, step, cost, pass_id=None, chunk_index=None):
+        """Run the checks on one loss WITHOUT a ring write (the chunk
+        already recorded via :meth:`record_chunk`) — the anomaly names
+        the real offending global step and its ``chunk_index`` inside
+        the chunk, not the chunk boundary. Returns the anomaly record
+        (or None); halt mode raises exactly like :meth:`step`."""
+        if not self.enabled:
+            return None
+        found = self._check(cost)
+        if found is None:
+            return None
+        return self._trip(step, cost, found, pass_id=pass_id,
+                          chunk_index=chunk_index)
+
+    def _trip(self, step, cost, found, pass_id=None, chunk_index=None):
+        """One anomalous loss: dedup per kind, emit + dump the black box,
+        raise in halt mode. Shared by the per-step and chunked paths."""
         kind, threshold = found
         if kind in self._tripped_kinds:
             # warn mode keeps training through a persistently-bad loss
@@ -218,6 +262,8 @@ class Sentinel:
             anomaly["cost"] = c if math.isfinite(c) else repr(c)
         if threshold is not None:
             anomaly["threshold"] = round(threshold, 6)
+        if chunk_index is not None:
+            anomaly["chunk_index"] = int(chunk_index)
         self.anomalies.append(anomaly)
         self._emit(anomaly)
         self._dump("anomaly:" + kind, anomaly)
@@ -254,7 +300,8 @@ class Sentinel:
                 anomaly["step"], anomaly["kind"],
                 cost=anomaly.get("cost"),
                 threshold=anomaly.get("threshold"), mode=self.mode,
-                pass_id=anomaly.get("pass"))
+                pass_id=anomaly.get("pass"),
+                chunk_index=anomaly.get("chunk_index"))
 
     def _dump(self, reason, anomaly):
         extra = {"mode": self.mode}

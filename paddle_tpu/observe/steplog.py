@@ -32,6 +32,15 @@ Record types (field ``type``):
   conversion + device dispatch), ``examples``, ``depth`` (pipeline
   depth), and for sequence feeds ``bucket`` (padded length),
   ``fill_tokens``/``pad_tokens`` (padding-waste accounting).
+* ``train_chunk`` — one fused multi-step dispatch (trainer
+  ``steps_per_call=K``): ``step`` (global step of the chunk's FIRST
+  step), ``steps`` (real steps in the chunk — K, or less for a partial
+  final/bucket-boundary chunk), ``wall_ms`` (interval between
+  successive chunk finalizations — the only honest wall time inside a
+  fused region; the chunk's per-step ``step`` records carry none),
+  ``feed_ms`` (summed feed stall), ``cost_first``/``cost_last``,
+  ``examples`` (chunk total), ``examples_per_sec``, ``pass``/``batch``
+  (first batch id of the chunk).
 * ``serve_request`` — one completed inference request through the
   serving engine (paddle_tpu.serve): ``rows``, ``queue_ms`` (time spent
   waiting for a batch flush), ``latency_ms`` (enqueue -> result),
@@ -57,6 +66,7 @@ a record type, fields are only ever added, never renamed (bump
 """
 
 import json
+import math
 import os
 import threading
 import time
@@ -270,6 +280,34 @@ class StepLog:
             rec["pad_tokens"] = int(pad_tokens)
         self.write(rec)
 
+    def log_train_chunk(self, step, steps, pass_id=None, batch_id=None,
+                        wall_ms=None, feed_ms=None, cost_first=None,
+                        cost_last=None, examples=None):
+        """One fused multi-step dispatch (trainer ``steps_per_call=K``);
+        ``step`` is the chunk's FIRST global step, ``steps`` the number
+        of real steps it fused."""
+        rec = {"type": "train_chunk", "step": int(step),
+               "steps": int(steps),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if pass_id is not None:
+            rec["pass"] = int(pass_id)
+        if batch_id is not None:
+            rec["batch"] = int(batch_id)
+        if wall_ms is not None:
+            rec["wall_ms"] = round(float(wall_ms), 4)
+        if feed_ms is not None:
+            rec["feed_ms"] = round(float(feed_ms), 4)
+        if cost_first is not None and math.isfinite(float(cost_first)):
+            rec["cost_first"] = round(float(cost_first), 6)
+        if cost_last is not None and math.isfinite(float(cost_last)):
+            rec["cost_last"] = round(float(cost_last), 6)
+        if examples is not None:
+            rec["examples"] = int(examples)
+            if wall_ms:
+                rec["examples_per_sec"] = round(
+                    examples / wall_ms * 1000.0, 2)
+        self.write(rec)
+
     def log_serve_request(self, rows, queue_ms, latency_ms=None,
                           req_id=None):
         """One completed serving request (paddle_tpu.serve engine)."""
@@ -303,8 +341,10 @@ class StepLog:
         self.write(rec)
 
     def log_anomaly(self, step, kind, cost=None, threshold=None,
-                    mode=None, pass_id=None):
-        """One sentinel trip (observe/sentinel.py)."""
+                    mode=None, pass_id=None, chunk_index=None):
+        """One sentinel trip (observe/sentinel.py). ``chunk_index`` is
+        the offending step's position inside a fused chunk (trainer
+        ``steps_per_call=``), when the trip came from a chunk scan."""
         rec = {"type": "anomaly", "step": int(step), "kind": str(kind),
                "t": round(time.perf_counter() - self._t0, 4)}
         if cost is not None:
@@ -315,6 +355,8 @@ class StepLog:
             rec["mode"] = str(mode)
         if pass_id is not None:
             rec["pass"] = int(pass_id)
+        if chunk_index is not None:
+            rec["chunk_index"] = int(chunk_index)
         self.write(rec)
 
     def log_crash_report(self, reason, steps, captured=None,
@@ -387,6 +429,22 @@ def summarize_dir(directory):
         meta = next((r for r in records if r.get("type") == "meta"), {})
         events = [r for r in records if r.get("type") == "event"]
         walls = [r["wall_ms"] for r in steps if "wall_ms" in r]
+        chunks = [r for r in records if r.get("type") == "train_chunk"]
+        if not walls and chunks:
+            # fused runs (steps_per_call=K): per-step wall time is
+            # unmeasurable, so amortize each chunk's interval over its
+            # real steps — `cli observe` keeps its one-command step-time
+            # view for exactly the dispatch-bound runs the fused loop
+            # targets. The first chunk (compile) contributes ONE entry
+            # so the steady tail (walls[1:]) excludes it, matching the
+            # per-step path's first-record convention.
+            walls = []
+            for j, c in enumerate(chunks):
+                if "wall_ms" not in c:
+                    continue
+                per = c["wall_ms"] / max(c["steps"], 1)
+                walls.extend([per] if j == 0
+                             else [per] * max(c["steps"], 1))
         run = {"file": os.path.basename(path),
                "run": meta.get("run"), "schema": meta.get("schema"),
                "backend": meta.get("backend"), "steps": len(steps),
@@ -423,8 +481,16 @@ def summarize_dir(directory):
             if fill + pad:
                 run["feed_padding_waste_pct"] = round(
                     100.0 * pad / (fill + pad), 2)
+        if chunks:
+            run["fused_chunks"] = len(chunks)
+            spc = meta.get("steps_per_call")
+            if spc is not None:
+                run["steps_per_call"] = spc
         ex = [r["examples_per_sec"] for r in steps
               if "examples_per_sec" in r]
+        if not ex:
+            ex = [c["examples_per_sec"] for c in chunks
+                  if "examples_per_sec" in c]
         if ex:
             run["examples_per_sec_best"] = round(max(ex), 2)
         costs = [r["cost"] for r in steps if "cost" in r]

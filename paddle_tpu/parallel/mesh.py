@@ -131,6 +131,22 @@ class DataParallel:
 
         return run
 
+    def shard_train_chunk(self, train_chunk, trainer):
+        """Fused multi-step twin of :meth:`shard_train_step`: the chunked
+        scan runs as ONE pjit program with the same donated carries. Each
+        member feed of the length-K chunk tuple gets the exact
+        :meth:`shard_batch` placement of the per-step path — idempotent,
+        so a DeviceFeeder chunk (pre-placed on the producer thread)
+        passes through for free."""
+        jitted = jax.jit(train_chunk, donate_argnums=(0, 1, 3, 4))
+
+        def run(trainable, replica, static, state, opt_state, feeds, rng):
+            feeds = tuple(self.shard_batch(f) for f in feeds)
+            return jitted(trainable, replica, static, state, opt_state,
+                          feeds, rng)
+
+        return run
+
     def shard_eval_step(self, eval_step, trainer):
         jitted = jax.jit(eval_step)
 

@@ -162,13 +162,55 @@ class SGD:
             outs = {o.name: values[o.name] for o in self.extra_outputs}
             return cost_total, eval_stats, outs
 
+        def train_chunk(trainable, replica, static, state, opt_state,
+                        feeds, rng):
+            # Multi-step fused region (train steps_per_call=K): K
+            # optimizer steps as ONE lax.scan dispatch. ``feeds`` arrives
+            # as a length-K tuple of device-resident trees and is stacked
+            # INSIDE the program, and the per-step rng keys are split
+            # from the ``rng`` carry in here too — the same sequential
+            # threefry splits the per-step loop does eagerly, so the key
+            # stream (dropout masks etc.) is K-invariant, but without
+            # per-step host dispatches (eager split + eager jnp.stack
+            # are exactly the overhead the scan exists to kill). The
+            # trainable/replica/running-state/optimizer carries stay
+            # device-resident across the whole chunk and are donated
+            # exactly like the per-step program's, so the host is visited
+            # once per K steps: losses/eval stats come back as length-K
+            # stacks read at chunk finalize, with the advanced rng carry.
+            step_rngs = []
+            for _ in range(len(feeds)):
+                rng, step_rng = jax.random.split(rng)
+                step_rngs.append(step_rng)
+            xs = (jax.tree.map(lambda *x: jnp.stack(x), *feeds),
+                  jnp.stack(step_rngs))
+
+            def body(carry, x):
+                tr, rep, st, opt = carry
+                feed, step_rng = x
+                (loss, tr, rep, st, opt, stats) = train_step(
+                    tr, rep, static, st, opt, feed, step_rng)
+                return (tr, rep, st, opt), (loss, stats)
+
+            carry = (trainable, replica, state, opt_state)
+            (tr, rep, st, opt), (losses, stats) = jax.lax.scan(
+                body, carry, xs)
+            return losses, tr, rep, st, opt, stats, rng
+
         if self.parallelism is not None:
             self._train_step = self.parallelism.shard_train_step(
                 train_step, self)
             self._eval_step = self.parallelism.shard_eval_step(eval_step, self)
+            # fused chunks need a strategy-aware wrapper; strategies
+            # without one reject steps_per_call loudly at train() time
+            self._train_chunk = (
+                self.parallelism.shard_train_chunk(train_chunk, self)
+                if hasattr(self.parallelism, "shard_train_chunk") else None)
         else:
             self._train_step = jax.jit(train_step,
                                        donate_argnums=(0, 1, 3, 4))
+            self._train_chunk = jax.jit(train_chunk,
+                                        donate_argnums=(0, 1, 3, 4))
             self._eval_step = jax.jit(eval_step)
 
         # device-resident training state
@@ -191,7 +233,7 @@ class SGD:
     # -- main loop ----------------------------------------------------------
     def train(self, reader, num_passes=1, event_handler=None, feeding=None,
               sync_params=True, test_reader=None, feed_pipeline=False,
-              buckets=None):
+              buckets=None, steps_per_call=None):
         """Event-driven training (v2 SGD.train parity). ``reader`` yields
         minibatches (lists of sample tuples). With ``test_reader`` and a
         nonzero ``test_period`` flag, an evaluation pass runs every N
@@ -214,6 +256,26 @@ class SGD:
         entries when pass-to-pass leftovers vary, e.g. under shuffling);
         pass the dict form ``buckets={"boundaries": [...],
         "drop_remainder": True}`` to drop them instead.
+
+        ``steps_per_call=K`` (docs/data.md "Multi-step fused training
+        loop"): run K optimizer steps per dispatch as one jitted
+        ``lax.scan`` over a chunk of K device-resident feeds with the
+        trainable/replica/state/optimizer carries donated — the host is
+        visited once per chunk instead of once per step (the
+        dispatch-bound fix for scan-heavy models, observe/attribution
+        ``dispatch_gap``). Implies the pipelined feed (the DeviceFeeder
+        queue is auto-deepened to >= K); losses and evaluator stats come
+        back as length-K stacks read at chunk finalize, so per-step
+        events (``EndIteration`` etc.), steplog ``step`` records, and
+        sentinel checks still fire once per real step — one dispatch
+        behind, at chunk granularity (sentinel latency, checkpoint
+        boundaries and per-step wall timing all coarsen to the chunk; the
+        chunk itself is the additive ``train_chunk`` steplog record).
+        ``K=1`` runs the byte-identical per-step program through the
+        chunked loop; the default (None/0) is the historical path,
+        untouched. Partial final chunks (K does not divide the pass
+        length, or a bucket boundary splits a chunk) scan at their own
+        length — one extra compile per distinct chunk size.
         """
         if event_handler is None:
             event_handler = default_event_handler
@@ -229,6 +291,13 @@ class SGD:
                 drop_remainder=bool(opts.get("drop_remainder", False)),
                 length_of=data_bucketing.topology_length_of(
                     self.topology, feeding))
+        k = int(steps_per_call or 0)
+        if k:
+            enforce(k >= 1, "steps_per_call must be >= 1, got %d", k)
+            enforce(self._train_chunk is not None,
+                    "steps_per_call requires a parallelism with a "
+                    "shard_train_chunk wrapper (%s has none)",
+                    type(self.parallelism).__name__)
         log_period = flags.get_flag("log_period")
         test_period = flags.get_flag("test_period")
 
@@ -239,8 +308,10 @@ class SGD:
         # PADDLE_TPU_TELEMETRY=<dir>, a JSONL step log + Chrome-trace
         # export of the spans (docs/observability.md).
         tracer = observe_spans.get_tracer()
-        slog = observe_steplog.from_env(
-            meta={"phase": "train", "num_passes": int(num_passes)})
+        meta = {"phase": "train", "num_passes": int(num_passes)}
+        if k:
+            meta["steps_per_call"] = k
+        slog = observe_steplog.from_env(meta=meta)
         prev_recording = tracer.record_events
         if slog is not None:
             # telemetry may be flag-configured (no env var), so force
@@ -258,10 +329,18 @@ class SGD:
         # up as an ``event`` record too when jax.monitoring emits it)
         last_final = {"t": time.perf_counter()}
         try:
-            self._train_passes(reader, num_passes, event_handler, feeding,
-                               sync_params, test_reader, log_period,
-                               test_period, slog, last_final, sentinel,
-                               feed_pipeline=feed_pipeline)
+            if k:
+                self._train_passes_fused(
+                    reader, num_passes, event_handler, feeding,
+                    sync_params, test_reader, log_period, test_period,
+                    slog, last_final, sentinel, k,
+                    feed_depth=self._feed_depth(feed_pipeline))
+            else:
+                self._train_passes(reader, num_passes, event_handler,
+                                   feeding, sync_params, test_reader,
+                                   log_period, test_period, slog,
+                                   last_final, sentinel,
+                                   feed_pipeline=feed_pipeline)
         except BaseException as exc:
             # any escape from the training loop dumps the black box
             # (a sentinel halt already dumped; on_exception skips it)
@@ -399,8 +478,7 @@ class SGD:
                 # to the step thread.
                 from paddle_tpu.data.feeder import DeviceFeeder
 
-                depth = 2 if feed_pipeline is True \
-                    else max(int(feed_pipeline), 1)
+                depth = self._feed_depth(feed_pipeline)
                 if feeder is None:
                     feeder = DeviceFeeder(reader, self.topology,
                                           feeding=feeding, depth=depth,
@@ -428,33 +506,220 @@ class SGD:
                     batch_id += 1
             if pending is not None:
                 finalize(pending)
-            if test_reader is not None and not test_period:
-                # flag default 0 = one test pass per training pass
-                result = self.test(test_reader, feeding=feeding,
-                                   pass_id=pass_id)
-                logger.info("pass %d test: cost=%.6f %s", pass_id,
-                            result.cost, _fmt_metrics(result.metrics))
-                event_handler(result)
-                # next pass's first step must not absorb this eval pass
-                last_final["t"] = time.perf_counter()
-            if sync_params:
-                self._sync_back()
-            pass_metrics = {e.name: e.result(eval_acc[e.name])
-                            for e in self.evaluators}
-            if slog is not None:
-                slog.log_pass(pass_id, metrics=pass_metrics)
-            if observe_steplog.stats_enabled():
-                # reference per-pass timer dump: globalStat.printAllStatus
-                # + reset at FinishTrainPass (paddle/trainer/Trainer.cpp)
-                global_stats.print_all()
-                global_stats.reset()
-            event_handler(v2_event.EndPass(pass_id, pass_metrics, gm=self))
-            # pass-boundary work (_sync_back, stats dump, EndPass handlers
-            # — e.g. a checkpoint save) must not be charged to the next
-            # pass's first step wall_ms
+            self._finish_pass(pass_id, eval_acc, event_handler, feeding,
+                              sync_params, test_reader, test_period, slog,
+                              last_final)
+        if sync_params:
+            self._sync_back()
+
+    def _finish_pass(self, pass_id, eval_acc, event_handler, feeding,
+                     sync_params, test_reader, test_period, slog,
+                     last_final):
+        """Pass-boundary sequence shared by the per-step and fused loops
+        (per-pass test, sync-back, pass metrics/record, stats dump,
+        EndPass) — ONE ordering for every loop shape."""
+        if test_reader is not None and not test_period:
+            # flag default 0 = one test pass per training pass
+            result = self.test(test_reader, feeding=feeding,
+                               pass_id=pass_id)
+            logger.info("pass %d test: cost=%.6f %s", pass_id,
+                        result.cost, _fmt_metrics(result.metrics))
+            event_handler(result)
+            # next pass's first step must not absorb this eval pass
             last_final["t"] = time.perf_counter()
         if sync_params:
             self._sync_back()
+        pass_metrics = {e.name: e.result(eval_acc[e.name])
+                        for e in self.evaluators}
+        if slog is not None:
+            slog.log_pass(pass_id, metrics=pass_metrics)
+        if observe_steplog.stats_enabled():
+            # reference per-pass timer dump: globalStat.printAllStatus
+            # + reset at FinishTrainPass (paddle/trainer/Trainer.cpp)
+            global_stats.print_all()
+            global_stats.reset()
+        event_handler(v2_event.EndPass(pass_id, pass_metrics, gm=self))
+        # pass-boundary work (_sync_back, stats dump, EndPass handlers
+        # — e.g. a checkpoint save) must not be charged to the next
+        # pass's first step wall_ms
+        last_final["t"] = time.perf_counter()
+
+    def _train_passes_fused(self, reader, num_passes, event_handler,
+                            feeding, sync_params, test_reader, log_period,
+                            test_period, slog, last_final, sentinel, k,
+                            feed_depth=2):
+        """The steps_per_call=K loop: chunks of K device-resident feeds
+        (DeviceFeeder.chunks) through ONE scan dispatch, one-deep
+        pipelined like the per-step loop — chunk c+1 is dispatched before
+        chunk c's length-K loss/stat stacks are read back. Per-step
+        events, steplog ``step`` records, metrics and sentinel checks all
+        still fire once per real step at finalize, K at a time; per-step
+        wall time is unmeasurable inside a fused region, so ``step``
+        records carry no wall_ms and the chunk's interval lands on the
+        ``train_chunk`` record instead."""
+        from paddle_tpu.data.feeder import DeviceFeeder
+
+        (m_steps, m_examples, m_loss,
+         m_examples_per_sec) = self._train_metrics()
+        # ONE feeder across passes, like the per-step pipelined loop
+        feeder = DeviceFeeder(reader, self.topology, feeding=feeding,
+                              depth=max(int(feed_depth), k),
+                              parallelism=self.parallelism)
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            eval_acc = {e.name: None for e in self.evaluators}
+            batch_id = 0
+            pending = None  # (batch_id, base_step, losses, stats, chunk)
+
+            def finalize(item):
+                b_id, base_step, losses, stats, chunk = item
+                with observe_spans.span("eval_readback"):
+                    costs = np.atleast_1d(
+                        np.asarray(jax.device_get(losses), dtype=np.float64))
+                    host_stats = (jax.device_get(stats)
+                                  if self.evaluators else {})
+                now = time.perf_counter()
+                wall_ms = (now - last_final["t"]) * 1000.0
+                last_final["t"] = now
+                n = len(costs)
+                if slog is not None:
+                    slog.log_train_chunk(
+                        step=base_step + 1, steps=n, pass_id=pass_id,
+                        batch_id=b_id, wall_ms=wall_ms,
+                        feed_ms=chunk.stall_ms,
+                        cost_first=float(costs[0]),
+                        cost_last=float(costs[-1]),
+                        examples=chunk.examples)
+                if wall_ms > 0:
+                    m_examples_per_sec.set(
+                        chunk.examples / wall_ms * 1000.0)
+                if sentinel is not None:
+                    # chunk granularity: ONE ring record per chunk; the
+                    # per-loss checks run inside the per-step loop below,
+                    # at the same point of the finalize sequence as the
+                    # legacy path (a halt-mode trip must not swallow the
+                    # records/events of the chunk's pre-anomaly steps)
+                    sentinel.record_chunk(base_step + 1, costs,
+                                          pass_id=pass_id, batch_id=b_id,
+                                          wall_ms=round(wall_ms, 4))
+                for i in range(n):
+                    gstep = base_step + i + 1
+                    metrics = {}
+                    for e in self.evaluators:
+                        per = host_stats[e.name]
+                        # evaluator stats may be arbitrary pytrees; a
+                        # stacked chunk carries step i at leading index i
+                        eval_acc[e.name] = e.merge(
+                            eval_acc[e.name],
+                            jax.tree.map(lambda a: a[i], per)
+                            if chunk.stacked else per)
+                        metrics[e.name] = e.result(eval_acc[e.name])
+                    cost_i = float(costs[i])
+                    if slog is not None:
+                        slog.log_step(
+                            step=gstep, pass_id=pass_id, batch_id=b_id + i,
+                            cost=cost_i,
+                            examples=chunk.batches[i].examples,
+                            metrics=metrics)
+                    m_steps.inc()
+                    m_examples.inc(chunk.batches[i].examples)
+                    m_loss.set(cost_i)
+                    if sentinel is not None:
+                        # same position as the legacy finalize: the
+                        # anomalous step's record/metrics land, halt
+                        # raises before its events fire
+                        sentinel.check(gstep, cost_i, pass_id=pass_id,
+                                       chunk_index=i)
+                    event_handler(v2_event.EndForwardBackward(
+                        pass_id, b_id + i, gm=self))
+                    if log_period and (b_id + i) % log_period == 0:
+                        logger.info("pass %d batch %d cost=%.6f %s",
+                                    pass_id, b_id + i, cost_i,
+                                    _fmt_metrics(metrics))
+                        if flags.get_flag("show_layer_stat"):
+                            self._log_layer_stats(chunk.batches[i].feed)
+                    psp = flags.get_flag("show_parameter_stats_period")
+                    if psp and gstep % max(psp, 1) == 0:
+                        self._log_param_stats()
+                    if (test_reader is not None and test_period
+                            and gstep % test_period == 0):
+                        result = self.test(test_reader, feeding=feeding,
+                                           pass_id=pass_id)
+                        logger.info("periodic test: cost=%.6f %s",
+                                    result.cost,
+                                    _fmt_metrics(result.metrics))
+                        event_handler(result)
+                        # the eval pass must not be charged to the next
+                        # chunk's wall interval
+                        last_final["t"] = time.perf_counter()
+                    event_handler(v2_event.EndIteration(
+                        pass_id, b_id + i, cost_i, metrics))
+
+            for chunk in feeder.chunks(k):
+                # every real step of the chunk announces itself before
+                # the fused dispatch, so the reference ordering
+                # BeginIteration(b) < EndForwardBackward(b) <
+                # EndIteration(b) holds for any K
+                for i in range(chunk.steps):
+                    event_handler(v2_event.BeginIteration(
+                        pass_id, batch_id + i))
+                with observe_spans.span("train_chunk",
+                                        args={"steps": chunk.steps}):
+                    if chunk.stacked:
+                        # the rng carry advances INSIDE the fused program
+                        # through the same sequential split stream as the
+                        # per-step loop — fixed-seed trajectories are
+                        # K-invariant
+                        (losses, self._trainable, self._replica,
+                         self._state, self._opt_state, stats,
+                         self._rng) = self._train_chunk(
+                            self._trainable, self._replica, self._static,
+                            self._state, self._opt_state, chunk.feed,
+                            self._rng)
+                    else:
+                        # single-step chunk (K=1, or a remainder/bucket
+                        # boundary): the ordinary per-step program —
+                        # byte-identical math, no scan-of-1 compile
+                        self._rng, step_rng = jax.random.split(self._rng)
+                        (losses, self._trainable, self._replica,
+                         self._state, self._opt_state,
+                         stats) = self._train_step(
+                            self._trainable, self._replica, self._static,
+                            self._state, self._opt_state, chunk.feed,
+                            step_rng)
+                base_step = self._step_count
+                self._step_count += chunk.steps
+                if slog is not None:
+                    for i, fb in enumerate(chunk.batches):
+                        slog.log_feed(
+                            step=base_step + i + 1, stall_ms=fb.stall_ms,
+                            convert_ms=fb.convert_ms,
+                            examples=fb.examples, depth=feeder.depth,
+                            bucket=fb.bucket, fill_tokens=fb.fill_tokens,
+                            pad_tokens=fb.pad_tokens)
+                if pending is not None:
+                    finalize(pending)
+                pending = (batch_id, base_step, losses, stats, chunk)
+                batch_id += chunk.steps
+            if pending is not None:
+                finalize(pending)
+            self._finish_pass(pass_id, eval_acc, event_handler, feeding,
+                              sync_params, test_reader, test_period, slog,
+                              last_final)
+        if sync_params:
+            self._sync_back()
+
+    @staticmethod
+    def _feed_depth(feed_pipeline):
+        """Queue depth encoded in train()'s ``feed_pipeline`` argument —
+        ONE interpretation shared by the per-step and fused loops: an
+        explicit int is the depth; ``True`` (and off, for the fused
+        loop's implied pipeline) means the default 2. Booleans checked
+        first: ``1 == True`` in Python, so a membership/equality test
+        would misread depth 1 as the bool."""
+        if isinstance(feed_pipeline, bool) or not feed_pipeline:
+            return 2
+        return max(int(feed_pipeline), 1)
 
     def _pending_step_of(self, batch_id):
         """Global step number of a pipelined batch being finalized (the
