@@ -1,0 +1,68 @@
+"""Framework-aware static analysis (docs/analyze.md).
+
+Three parts, one subsystem:
+
+* :mod:`paddle_tpu.analyze.lint` — AST checkers for the hazard classes
+  every PR so far has re-discovered by hand: host syncs on step paths
+  (PTA001), jit-cache busters (PTA002), unmanaged threads (PTA003),
+  unlocked module-level registries (PTA004). ``cli analyze --all`` runs
+  them over the source tree and exits non-zero on findings — the CI
+  one-liner next to ``cli observe --regress``.
+* :mod:`paddle_tpu.analyze.topology_check` — pre-compile checks on a
+  built topology, no tracing: packing legality (the cross-position
+  layer set is DERIVED from the layer sources, not hand-listed), index
+  feed promotions, label quantization under mixed precision, donation
+  conflicts, and the exact set of jit entry shapes a
+  ``(topology, buckets, steps_per_call)`` combination will mint.
+  ``PADDLE_TPU_ANALYZE=1`` makes ``trainer.SGD.train`` run it before
+  the first dispatch.
+* :mod:`paddle_tpu.analyze.pytest_plugin` — dynamic gates for tier-1:
+  a per-test thread-leak gate and a :func:`max_retraces` compile
+  budget backed by the ``jax.monitoring`` listener in
+  ``observe/steplog.py``.
+"""
+
+import contextlib
+
+from paddle_tpu.analyze.lint import (  # noqa: F401
+    CHECKERS,
+    Finding,
+    format_finding,
+    lint_paths,
+    lint_source,
+    lint_tree,
+)
+from paddle_tpu.analyze.topology_check import (  # noqa: F401
+    check_topology,
+    format_report,
+    predict_jit_entries,
+    scan_layer_modules,
+    verify_reject_packed_coverage,
+)
+
+
+class RetraceBudgetExceeded(AssertionError):
+    """A code region compiled more programs than its declared budget."""
+
+
+@contextlib.contextmanager
+def max_retraces(n):
+    """Fail if the enclosed region mints more than ``n`` compiled
+    programs (counted via the process-wide ``jax.monitoring`` listener,
+    observe/steplog.py — backend_compile events, so cache hits are
+    free). The dynamic half of :func:`predict_jit_entries`: the
+    topology checker predicts the entry set, this pins the live count.
+
+    Counting is process-global: anything compiled by OTHER threads
+    during the region charges the budget too — by design (a background
+    feeder minting shapes is exactly the leak this exists to catch).
+    Warm shared helpers before the region when pinning exact counts.
+    """
+    from paddle_tpu.observe import steplog
+
+    with steplog.watch_compiles() as watcher:
+        yield watcher
+    if watcher.compiles > n:
+        raise RetraceBudgetExceeded(
+            "retrace budget exceeded: %d programs compiled, budget %d "
+            "(events: %s)" % (watcher.compiles, n, watcher.events))

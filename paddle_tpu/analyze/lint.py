@@ -1,0 +1,606 @@
+"""Framework-aware AST lint over the paddle_tpu source tree.
+
+The reference institutionalized correctness tooling as scripts + build
+wiring (``paddle/scripts`` lint, ASAN in cmake); the TPU-native
+equivalents of those bug classes are invisible to generic linters — a
+stray ``.item()`` is legal Python, it just costs an on-chip round per
+step. Each checker here encodes one hazard class the previous PRs
+debugged by hand, with an ID, a fix-it hint, and an inline suppression
+syntax:
+
+* **PTA001 host-sync-in-hot-path** — ``.item()``, ``jax.device_get``,
+  ``block_until_ready``, or ``float()/int()/np.asarray()`` on a value
+  returned by a device step, reachable from a known hot path (trainer
+  step loops, serve engine/bundle execution, feeder threads) and NOT
+  inside an ``observe_spans.span(...)`` block. Spans are the sanctioned
+  materialization points: a sync inside one is measured and deliberate;
+  a sync outside one silently serializes the pipeline (PR 6 found
+  ~3 ms/step of exactly this).
+* **PTA002 jit-cache-buster** — inside a function handed to
+  ``jax.jit``/``pjit``/``lax.scan``: Python branching on a traced
+  argument (``if x > 0:`` concretizes the tracer — error at best,
+  silent retrace-per-value at worst), ``float()/int()/bool()`` on a
+  traced argument, f-strings in jit/named_call names (a fresh name per
+  call defeats any name-keyed caching or trace grouping), and list/
+  dict/set literals passed in ``static_argnums`` positions (unhashable
+  — every call re-traces or raises).
+* **PTA003 unmanaged-thread** — ``threading.Thread(...)`` without a
+  ``name=``. Anonymous threads defeat the thread-leak gate
+  (analyze/pytest_plugin.py) and every postmortem; the codebase idiom
+  is a named daemon thread with a cancellation handshake
+  (data/feeder.py, reader/decorator.py ``_cancellable_put``).
+* **PTA004 unlocked-registry** — in a module that uses threading:
+  mutation of a module-level container (dict/list/set/WeakSet/...)
+  outside a ``with <module-lock>:`` block. Module registries are shared
+  by every thread in the process (metrics registry, steplog listener
+  set); an unlocked mutation is a data race that only fires under
+  serving load.
+
+Suppression: append ``# paddle-lint: disable=PTA001`` (comma-separate
+multiple IDs, or ``disable=all``) to the flagged line or the line just
+above it. Suppressions are deliberately line-scoped — a file-wide
+opt-out would rot.
+
+The checked-in tree lints clean (tests/test_analyze.py pins it); the
+fixture tests pin that each checker still fires on its hazard class.
+"""
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+
+# -- catalog -----------------------------------------------------------------
+
+CHECKERS = {
+    "PTA001": ("host-sync-in-hot-path",
+               "materialize inside an observe_spans.span(...) block (the "
+               "measured, sanctioned sync point) or keep the value "
+               "device-resident"),
+    "PTA002": ("jit-cache-buster",
+               "branch with lax.cond/jnp.where, mark the argument static "
+               "(and hashable), and give jit names static strings"),
+    "PTA003": ("unmanaged-thread",
+               "name the thread and reuse the cancellation idiom "
+               "(data/feeder.py: named daemon thread + "
+               "reader.decorator._cancellable_put/_drain)"),
+    "PTA004": ("unlocked-registry",
+               "guard the mutation with the module's lock (add a "
+               "module-level threading.Lock() if the module has none)"),
+}
+
+# Hot-path roots for PTA001, keyed by path suffix. Nested closures
+# (e.g. the trainer's per-pass ``finalize``) are scanned as part of
+# their enclosing hot function.
+HOT_PATHS = {
+    "trainer.py": {"_train_passes", "_train_passes_fused", "test"},
+    "serve/engine.py": {"submit", "_take_batch", "_loop", "_run_batch"},
+    "serve/bundle.py": {"run", "infer", "warmup"},
+    "data/feeder.py": {"_produce", "batches", "chunks"},
+}
+
+# Calls whose results are device-resident values: reading them back with
+# float()/np.asarray() outside a span is the PTA001 hazard.
+DEVICE_CALLS = {"_train_step", "_train_chunk", "_eval_step", "call", "run"}
+
+# Host-materializing wrappers that flag when applied to a device value.
+SYNC_WRAPPERS = {"float", "int", "asarray", "array", "atleast_1d"}
+
+JIT_NAMES = {"jit", "pjit"}
+MUTATORS = {"add", "append", "appendleft", "extend", "insert", "remove",
+            "discard", "pop", "popleft", "clear", "update", "setdefault"}
+CONTAINER_CTORS = {"set", "dict", "list", "deque", "defaultdict",
+                   "OrderedDict", "Counter", "WeakSet",
+                   "WeakValueDictionary", "WeakKeyDictionary"}
+LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*paddle-lint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+
+@dataclass
+class Finding:
+    checker: str
+    path: str
+    line: int
+    message: str
+
+    @property
+    def hint(self):
+        return CHECKERS[self.checker][1]
+
+    @property
+    def title(self):
+        return CHECKERS[self.checker][0]
+
+
+def format_finding(f):
+    return "%s:%d: %s [%s %s]\n    fix: %s" % (
+        f.path, f.line, f.message, f.checker, f.title, f.hint)
+
+
+# -- suppression -------------------------------------------------------------
+
+def _suppressions(source):
+    """{line_number: set of suppressed checker ids (or {"all"})} from
+    ``# paddle-lint: disable=...`` comments."""
+    out = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+            out.setdefault(tok.start[0], set()).update(
+                {"all"} if "all" in ids else ids)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _suppressed(finding, suppressions):
+    for line in (finding.line, finding.line - 1):
+        ids = suppressions.get(line)
+        if ids and ("all" in ids or finding.checker in ids):
+            return True
+    return False
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def _call_name(func):
+    """Trailing identifier of a call target: Name or Attribute."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _names_in(node):
+    """All Name identifiers in a subtree — used both for reads (span
+    lock contexts, sync-wrapper arguments) and for assignment targets
+    (tuple/list unpack and starred targets fall out of ast.walk)."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_span_with(node):
+    """True for ``with ...span(...):`` — the sanctioned sync scope."""
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call) and _call_name(expr.func) == "span":
+            return True
+    return False
+
+
+# -- PTA001: host sync in hot path -------------------------------------------
+
+class _HotPathChecker(ast.NodeVisitor):
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+        self.tracked = set()
+        self.span_depth = 0
+
+    def run(self, func_node):
+        self._collect_tracked(func_node)
+        for stmt in func_node.body:
+            self.visit(stmt)
+
+    def _collect_tracked(self, func_node):
+        """Names bound (directly or via iteration) to device-step
+        results. Two passes so iteration taint over a tracked name
+        (``for k, v in out.items():``) resolves."""
+        for _ in range(2):
+            for node in ast.walk(func_node):
+                if isinstance(node, ast.Assign):
+                    if self._is_device_call(node.value):
+                        for t in node.targets:
+                            self.tracked |= _names_in(t)
+                elif isinstance(node, (ast.For, ast.comprehension)):
+                    it = node.iter
+                    if _names_in(it) & self.tracked:
+                        self.tracked |= _names_in(node.target)
+
+    def _is_device_call(self, value):
+        return (isinstance(value, ast.Call)
+                and _call_name(value.func) in DEVICE_CALLS)
+
+    def visit_With(self, node):
+        if _is_span_with(node):
+            self.span_depth += 1
+            for stmt in node.body:
+                self.visit(stmt)
+            self.span_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if self.span_depth == 0:
+            name = _call_name(node.func)
+            if name == "item" and isinstance(node.func, ast.Attribute) \
+                    and not node.args:
+                self._flag(node, ".item() forces a device round-trip")
+            elif name in ("device_get", "block_until_ready"):
+                self._flag(node, "%s() synchronizes with the device"
+                           % name)
+            elif name in SYNC_WRAPPERS and node.args:
+                hit = _names_in(node.args[0]) & self.tracked
+                if hit:
+                    self._flag(node, "%s() on device value %r reads it "
+                               "back to the host" % (name, sorted(hit)[0]))
+        self.generic_visit(node)
+
+    def _flag(self, node, what):
+        self.findings.append(Finding(
+            "PTA001", self.path, node.lineno,
+            "%s on a hot path, outside any observe span" % what))
+
+
+def _check_hot_paths(tree, path, findings):
+    norm = path.replace(os.sep, "/")
+    hot = None
+    for suffix, names in HOT_PATHS.items():
+        if norm.endswith(suffix):
+            hot = names
+            break
+    if hot is None:
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in hot:
+            _HotPathChecker(path, findings).run(node)
+
+
+# -- PTA002: jit cache busters -----------------------------------------------
+
+def _jit_call(node):
+    """The jit-family call inside ``node``, unwrapping partial(...)."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = _call_name(node.func)
+    if name in JIT_NAMES:
+        return node
+    if name == "partial" and node.args:
+        if _call_name(node.args[0]) in JIT_NAMES:
+            return node
+    return None
+
+
+def _collect_jitted(tree):
+    """[(FunctionDef, jit Call-or-None)] for every function that is
+    jitted by decorator, wrapped by a jit/pjit call, or used as a
+    lax.scan body."""
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, []).append(node)
+    jitted = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for deco in node.decorator_list:
+                if _call_name(deco) in JIT_NAMES or _jit_call(deco):
+                    jitted.append((node, deco if isinstance(deco, ast.Call)
+                                   else None))
+        elif isinstance(node, ast.Call):
+            name = _call_name(node.func)
+            if name in JIT_NAMES and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                for fn in defs.get(node.args[0].id, ()):
+                    jitted.append((fn, node))
+            elif name == "scan" and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                for fn in defs.get(node.args[0].id, ()):
+                    jitted.append((fn, None))
+    return jitted
+
+
+def _traced_params(func_node, jit_call):
+    """Argument names traced by jit (static_argnums/argnames excluded)."""
+    a = func_node.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    static = set()
+    if jit_call is not None:
+        for kw in jit_call.keywords:
+            val = kw.value
+            if kw.arg == "static_argnums":
+                for c in ast.walk(val):
+                    if isinstance(c, ast.Constant) and isinstance(c.value,
+                                                                  int):
+                        if 0 <= c.value < len(names):
+                            static.add(names[c.value])
+            elif kw.arg == "static_argnames":
+                for c in ast.walk(val):
+                    if isinstance(c, ast.Constant) and isinstance(c.value,
+                                                                  str):
+                        static.add(c.value)
+    return {n for n in names if n not in static and n != "self"}
+
+
+def _tracer_in_test(test, params):
+    """A traced param used as a Python truth value in ``test`` (None
+    checks, isinstance/len calls and attribute access are static and
+    exempt). Returns the offending name or None."""
+    if isinstance(test, ast.Name):
+        return test.id if test.id in params else None
+    if isinstance(test, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return None
+        for operand in [test.left] + list(test.comparators):
+            if isinstance(operand, ast.Name) and operand.id in params:
+                return operand.id
+        return None
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            hit = _tracer_in_test(v, params)
+            if hit:
+                return hit
+        return None
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _tracer_in_test(test.operand, params)
+    return None
+
+
+def _check_jit_bodies(tree, path, findings):
+    seen = set()
+    for func_node, jit_call in _collect_jitted(tree):
+        key = (func_node.lineno, func_node.name)
+        if key in seen:
+            continue
+        seen.add(key)
+        params = _traced_params(func_node, jit_call)
+        for node in ast.walk(func_node):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                hit = _tracer_in_test(node.test, params)
+                if hit:
+                    findings.append(Finding(
+                        "PTA002", path, node.lineno,
+                        "Python branch on traced argument %r inside "
+                        "jitted %r — concretizes the tracer (or retraces "
+                        "per value)" % (hit, func_node.name)))
+            elif isinstance(node, ast.Call):
+                name = _call_name(node.func)
+                if name in ("float", "int", "bool") and node.args \
+                        and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in params:
+                    findings.append(Finding(
+                        "PTA002", path, node.lineno,
+                        "%s() on traced argument %r inside jitted %r "
+                        "forces concretization" % (name, node.args[0].id,
+                                                   func_node.name)))
+
+
+def _check_jit_callsites(tree, path, findings):
+    """f-strings in jit/named_call names; non-hashable literals passed
+    at static_argnums positions of a module-local jitted callable."""
+    static_of = {}  # assigned name -> sorted static argnums
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name in JIT_NAMES | {"named_call", "named_scope"}:
+            fstr = [a for a in list(node.args)
+                    + [k.value for k in node.keywords]
+                    if isinstance(a, ast.JoinedStr)]
+            if fstr:
+                findings.append(Finding(
+                    "PTA002", path, fstr[0].lineno,
+                    "f-string in %s name — a fresh name per call defeats "
+                    "name-keyed caching/trace grouping" % name))
+        if name in JIT_NAMES:
+            nums = []
+            for kw in node.keywords:
+                if kw.arg == "static_argnums":
+                    for c in ast.walk(kw.value):
+                        if isinstance(c, ast.Constant) \
+                                and isinstance(c.value, int):
+                            nums.append(c.value)
+            if nums:
+                parent = getattr(node, "_pl_parent", None)
+                if isinstance(parent, ast.Assign) \
+                        and len(parent.targets) == 1 \
+                        and isinstance(parent.targets[0], ast.Name):
+                    static_of[parent.targets[0].id] = sorted(nums)
+    if not static_of:
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in static_of:
+            for pos in static_of[node.func.id]:
+                if pos < len(node.args) and isinstance(
+                        node.args[pos],
+                        (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+                    findings.append(Finding(
+                        "PTA002", path, node.args[pos].lineno,
+                        "non-hashable literal passed at static_argnums "
+                        "position %d of %r — jit static args must hash "
+                        "(use a tuple)" % (pos, node.func.id)))
+
+
+# -- PTA003: unmanaged threads -----------------------------------------------
+
+def _check_threads(tree, path, findings):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node.func) != "Thread":
+            continue
+        kwargs = {kw.arg for kw in node.keywords}
+        if "name" not in kwargs:
+            findings.append(Finding(
+                "PTA003", path, node.lineno,
+                "threading.Thread(...) without name= — anonymous threads "
+                "are invisible to the leak gate and postmortems"))
+
+
+# -- PTA004: unlocked module registries --------------------------------------
+
+def _module_imports_threading(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] == "threading" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] == "threading":
+                return True
+    return False
+
+
+def _module_registries(tree):
+    """(container_names, lock_names) bound at module top level."""
+    containers, locks = set(), set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = set()
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+        if not names:
+            continue
+        value = node.value
+        if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+            containers |= names
+        elif isinstance(value, ast.Call):
+            ctor = _call_name(value.func)
+            if ctor in CONTAINER_CTORS:
+                containers |= names
+            elif ctor in LOCK_CTORS:
+                locks |= names
+    return containers, locks
+
+
+class _RegistryChecker(ast.NodeVisitor):
+    def __init__(self, path, containers, locks, findings):
+        self.path = path
+        self.containers = containers
+        self.locks = locks
+        self.findings = findings
+        self.lock_depth = 0
+        self.fn_depth = 0
+
+    def visit_With(self, node):
+        locked = any(_names_in(item.context_expr) & self.locks
+                     for item in node.items)
+        if locked:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.lock_depth -= 1
+
+    def _visit_fn(self, node):
+        self.fn_depth += 1
+        self.generic_visit(node)
+        self.fn_depth -= 1
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _flag(self, node, name, how):
+        if self.fn_depth == 0:
+            return  # import-time mutation: single-threaded by definition
+        if self.lock_depth > 0:
+            return
+        extra = (" (module locks: %s)" % ", ".join(sorted(self.locks))
+                 if self.locks else " (module defines no lock)")
+        self.findings.append(Finding(
+            "PTA004", self.path, node.lineno,
+            "module-level registry %r mutated via %s outside its lock%s"
+            % (name, how, extra)))
+
+    def visit_Call(self, node):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in MUTATORS \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in self.containers:
+            self._flag(node, func.value.id, ".%s()" % func.attr)
+        self.generic_visit(node)
+
+    def _sub_target(self, target):
+        if isinstance(target, ast.Subscript) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id in self.containers:
+            return target.value.id
+        return None
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            name = self._sub_target(t)
+            if name:
+                self._flag(node, name, "item assignment")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        name = self._sub_target(node.target)
+        if name:
+            self._flag(node, name, "augmented item assignment")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            name = self._sub_target(t)
+            if name:
+                self._flag(node, name, "item deletion")
+        self.generic_visit(node)
+
+
+def _check_registries(tree, path, findings):
+    if not _module_imports_threading(tree):
+        return
+    containers, locks = _module_registries(tree)
+    if not containers:
+        return
+    _RegistryChecker(path, containers, locks, findings).visit(tree)
+
+
+# -- driver ------------------------------------------------------------------
+
+def _annotate_parents(tree):
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._pl_parent = node
+
+
+def lint_source(source, path="<string>"):
+    """Lint one source string; returns unsuppressed [Finding]."""
+    tree = ast.parse(source, filename=path)
+    _annotate_parents(tree)
+    findings = []
+    _check_hot_paths(tree, path, findings)
+    _check_jit_bodies(tree, path, findings)
+    _check_jit_callsites(tree, path, findings)
+    _check_threads(tree, path, findings)
+    _check_registries(tree, path, findings)
+    suppressions = _suppressions(source)
+    kept = [f for f in findings if not _suppressed(f, suppressions)]
+    kept.sort(key=lambda f: (f.path, f.line, f.checker))
+    return kept
+
+
+def lint_paths(paths):
+    findings = []
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), path))
+    return findings
+
+
+def lint_tree(root=None):
+    """Lint every .py under ``root`` (default: the installed paddle_tpu
+    package). Returns (findings, files_checked)."""
+    if root is None:
+        import paddle_tpu
+
+        root = os.path.dirname(os.path.abspath(paddle_tpu.__file__))
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        paths.extend(os.path.join(dirpath, f)
+                     for f in sorted(filenames) if f.endswith(".py"))
+    return lint_paths(sorted(paths)), len(paths)

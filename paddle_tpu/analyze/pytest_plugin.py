@@ -1,0 +1,79 @@
+"""Dynamic analysis gates for the test suite (docs/analyze.md).
+
+Two gates, registered for the whole tier-1 run by tests/conftest.py:
+
+* **Thread-leak gate** — an autouse fixture snapshots the live Python
+  threads around every test and fails the test if it leaves new ones
+  running (after a short grace for clean shutdown paths to finish).
+  This generalizes the per-test leak assertions PR 5 hand-wrote for the
+  feeder/reader threads: ANY leaked thread fails, not just the ones a
+  test remembered to check. Opt a test out with
+  ``@pytest.mark.allow_thread_leaks`` (and say why).
+* **Retrace budget** — the ``max_retraces`` fixture returns the
+  :func:`paddle_tpu.analyze.max_retraces` context manager: a test
+  declares how many programs a region may compile and fails if the
+  live ``jax.monitoring`` compile-event count (observe/steplog.py)
+  exceeds it. This pins shape-minting guarantees (bucket counts,
+  steps_per_call K-invariance) that were previously asserted only by
+  trajectory equality.
+"""
+
+import threading
+import time
+
+import pytest
+
+# Seconds a finished test gets for its threads to wind down before the
+# gate calls them leaked (cancellation handshakes poll at 100 ms —
+# reader/decorator._cancellable_put — so 2 s is ~20 polls).
+LEAK_GRACE_S = 2.0
+
+# Thread-name prefixes never counted as leaks (test-harness machinery).
+ALLOWED_THREAD_PREFIXES = ("pytest-timeout",)
+
+
+def _leaked_threads(before):
+    return [t for t in threading.enumerate()
+            if t.ident not in before and t.is_alive()
+            and not t.name.startswith(ALLOWED_THREAD_PREFIXES)]
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "allow_thread_leaks: opt a test out of the analyze thread-leak "
+        "gate (justify in a comment)")
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_gate(request):
+    """Fail any test that leaves threads running (docs/analyze.md)."""
+    if request.node.get_closest_marker("allow_thread_leaks"):
+        yield
+        return
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    leaked = _leaked_threads(before)
+    deadline = time.monotonic() + LEAK_GRACE_S
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.02)
+        leaked = _leaked_threads(before)
+    if leaked:
+        pytest.fail(
+            "test leaked %d thread(s): %s — join them or wire the "
+            "cancellation idiom (data/feeder.py, "
+            "reader/decorator._cancellable_put); see docs/analyze.md"
+            % (len(leaked), sorted(t.name for t in leaked)),
+            pytrace=False)
+
+
+@pytest.fixture(name="max_retraces")
+def _max_retraces_fixture():
+    """The retrace-budget context manager as a fixture:
+
+    ``with max_retraces(3) as w: ...`` fails the test when the region
+    compiles more than 3 programs; ``w.compiles``/``w.events`` expose
+    the live count for exact-equality pins."""
+    from paddle_tpu.analyze import max_retraces as budget
+
+    return budget

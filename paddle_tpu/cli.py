@@ -457,6 +457,96 @@ def cmd_observe(args):
     return rc
 
 
+def cmd_analyze(args):
+    """Framework-aware static analysis (docs/analyze.md).
+
+    Default/``--all``: lint the paddle_tpu source tree (host syncs in
+    hot paths, jit-cache busters, unmanaged threads, unlocked
+    registries — checker catalog in paddle_tpu/analyze/lint.py) AND
+    verify the derived reject_packed coverage; exits non-zero on any
+    finding — the second CI one-liner, next to ``cli observe
+    --regress``. With ``--topology --config cfg.py``: build the
+    config's topology and run the pre-compile graph checks plus the
+    jit-entry-shape prediction for its reader/buckets/steps-per-call
+    combination (no tracing, no device)."""
+    from paddle_tpu.analyze import lint, topology_check
+
+    if args.topology:
+        if not args.config:
+            print("analyze --topology needs --config", file=sys.stderr)
+            return 2
+        from paddle_tpu import minibatch
+        from paddle_tpu.graph import reset_name_counters
+        from paddle_tpu.parameters import Parameters
+        from paddle_tpu.topology import Topology
+
+        reset_name_counters()
+        cfg = _load_config(args.config, getattr(args, "config_args", ""))
+        cost = cfg.cost()
+        params = Parameters.create(cost)
+        topo = Topology(cost)
+        report = topology_check.check_topology(
+            topo, parameters=params,
+            steps_per_call=args.steps_per_call or None)
+        buckets = ([int(b) for b in args.buckets.split(",") if b]
+                   if args.buckets else None)
+        if hasattr(cfg, "train_reader"):
+            batch_size = getattr(cfg, "batch_size", args.batch_size)
+            reader = minibatch.batch(cfg.train_reader(), batch_size)
+            if args.sample_batches:
+                import itertools
+
+                base = reader
+                reader = lambda: itertools.islice(  # noqa: E731
+                    base(), args.sample_batches)
+            report["jit_entries"] = topology_check.predict_jit_entries(
+                topo, reader, buckets=buckets,
+                steps_per_call=args.steps_per_call or None)
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            print(topology_check.format_report(report))
+            if "jit_entries" in report:
+                je = report["jit_entries"]
+                print("jit entries: %d program(s)" % je["programs"])
+                for e in je["entries"]:
+                    print("  %(kind)s rows=%(rows)d" % e
+                          + (" steps=%d" % e["steps"]
+                             if e["kind"] == "scan" else "")
+                          + (" pad=%s" % e["seq_pad"]
+                             if e["seq_pad"] else ""))
+        return 1 if report["errors"] else 0
+
+    if args.paths:
+        findings = lint.lint_paths(args.paths)
+        n_files = len(args.paths)
+    else:
+        findings, n_files = lint.lint_tree()
+    coverage = topology_check.verify_reject_packed_coverage()
+    rc = 1 if (findings or coverage["missing"]) else 0
+    if args.json:
+        print(json.dumps({
+            "files": n_files,
+            "findings": [f.__dict__ for f in findings],
+            "reject_packed": coverage}, indent=2))
+        return rc
+    for f in findings:
+        print(lint.format_finding(f))
+    for name in coverage["missing"]:
+        print("reject_packed coverage gap: layer %r mixes across time "
+              "positions but accepts packed input (derived set: %s)"
+              % (name, coverage["expected"]))
+    if rc == 0:
+        print("analyze clean: %d files, %d checkers, reject_packed "
+              "coverage %d/%d layers"
+              % (n_files, len(lint.CHECKERS),
+                 len(coverage["covered"]), len(coverage["expected"])))
+    else:
+        print("analyze: %d finding(s)" % (len(findings)
+                                          + len(coverage["missing"])))
+    return rc
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="paddle_tpu",
                                      description="paddle_tpu launcher")
@@ -519,6 +609,30 @@ def main(argv=None):
                    help="base tolerance %% before the row's own "
                         "spread_pct widens it")
     p.set_defaults(fn=cmd_observe)
+
+    p = sub.add_parser("analyze")
+    p.add_argument("paths", nargs="*",
+                   help="explicit files to lint (default: the installed "
+                        "paddle_tpu tree)")
+    p.add_argument("--all", action="store_true",
+                   help="full static-analysis gate (lint + reject_packed "
+                        "coverage; the default behavior, spelled out for "
+                        "the CI one-liner)")
+    p.add_argument("--topology", action="store_true",
+                   help="pre-compile topology checks + jit-entry-shape "
+                        "prediction for --config")
+    p.add_argument("--config", default="")
+    p.add_argument("--config-args", default="")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--buckets", default="",
+                   help="comma-separated bucket boundaries for the "
+                        "jit-entry prediction")
+    p.add_argument("--steps-per-call", type=int, default=0)
+    p.add_argument("--sample-batches", type=int, default=64,
+                   help="how many reader batches the jit-entry "
+                        "prediction simulates")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_analyze)
 
     p = sub.add_parser("merge_model")
     p.add_argument("--config", default="")

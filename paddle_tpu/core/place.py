@@ -59,6 +59,7 @@ class TPUPlace(Place):
 
 
 _state = threading.local()
+_default_lock = threading.Lock()
 _default = [None]
 
 
@@ -67,13 +68,17 @@ def default_place():
         import jax
 
         has_accel = any(d.platform != "cpu" for d in jax.devices())
-        _default[0] = TPUPlace() if has_accel else CPUPlace()
+        place = TPUPlace() if has_accel else CPUPlace()
+        with _default_lock:
+            if _default[0] is None:
+                _default[0] = place
     return _default[0]
 
 
 def set_default_place(place):
     enforce(isinstance(place, Place), "expected a Place, got %r", place)
-    _default[0] = place
+    with _default_lock:
+        _default[0] = place
 
 
 def device_count(place_type=None):

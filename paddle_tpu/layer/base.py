@@ -142,7 +142,13 @@ def reject_packed(value, what):
     reduction would collapse all packed neighbours into one output, a
     context window would read across segment boundaries — silently.
     Refuse loudly instead (use length bucketing, not packing, for such
-    models — docs/data.md)."""
+    models — docs/data.md).
+
+    Coverage is CHECKED, not remembered: the static analyzer derives
+    the cross-position layer set from the layer sources and tier-1
+    asserts every such layer calls this guard
+    (paddle_tpu/analyze/topology_check.py, docs/analyze.md) — a new
+    time-mixing layer that forgets it fails `cli analyze --all`."""
     enforce(not isinstance(value, PackedSequenceBatch),
             "%s does not support packed sequence batches: it would mix "
             "packed neighbours across segment boundaries; use length "

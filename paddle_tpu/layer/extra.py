@@ -45,15 +45,11 @@ def crf(input, label, size=None, weight=None, param_attr=None, name=None,
     def forward(params, values, ctx):
         scores, labels = values[0], values[1]
         enforce(is_seq(scores) and is_seq(labels), "crf expects sequences")
-        from paddle_tpu.core.sequence import PackedSequenceBatch
+        from paddle_tpu.layer.base import reject_packed
 
-        # the chain's transition scores would silently bridge packed
-        # neighbours — CRF costs need plain (bucketed, not packed) batches
-        enforce(not isinstance(scores, PackedSequenceBatch)
-                and not isinstance(labels, PackedSequenceBatch),
-                "crf does not support packed sequence batches: transitions "
-                "would cross packed-segment boundaries; use length "
-                "bucketing (paddle_tpu.data.bucketing) instead of packing")
+        # chain transitions would bridge packed neighbours
+        reject_packed(scores, "crf")
+        reject_packed(labels, "crf")
         nll = crf_ops.crf_nll(scores.data, labels.data, scores.mask(),
                               params[wspec.name])
         if weight is not None:

@@ -158,6 +158,9 @@ class context_projection(BaseProjection):
 
     def forward(self, params, value, ctx):
         enforce(is_seq(value), "context_projection expects a sequence")
+        from paddle_tpu.layer.base import reject_packed
+
+        reject_packed(value, "context_projection")  # window spans segments
         padding = params[self.specs[0].name] if self.specs else None
         out = seq_ops.context_projection(
             value.data, value.mask(), self.context_start, self.context_len,

@@ -386,7 +386,10 @@ def max_id(input, name=None, layer_attr=None):
 
         x = values[0]
         if is_seq(x):
-            return SequenceBatch(am(x.data), x.lengths)
+            # like(), not a bare SequenceBatch: a packed input keeps its
+            # segment ids, so downstream cross-position layers still see
+            # (and reject) the packing instead of silently mixing rows
+            return like(x, am(x.data))
         return am(x)
 
     return make_node("max_id", forward, [input], name=name, size=1,
@@ -423,7 +426,8 @@ def eos_id(input, eos_id, name=None, layer_attr=None):
             return (d == eos_id).astype(jnp.int32)
 
         if is_seq(x):
-            return SequenceBatch(check(x.data), x.lengths)
+            # keep packing metadata, as in max_id
+            return like(x, check(x.data))
         return check(x)
 
     return make_node("eos_id", forward, [input], name=name, size=1,

@@ -65,6 +65,7 @@ a record type, fields are only ever added, never renamed (bump
 ``SCHEMA_VERSION`` if that ever has to break).
 """
 
+import contextlib
 import json
 import math
 import os
@@ -74,32 +75,84 @@ import weakref
 
 SCHEMA_VERSION = 1
 
-# StepLogs currently subscribed to jax.monitoring events. Weak so a log
-# that was never closed (crashed run) doesn't stay pinned by the listener.
+# StepLogs (and CompileWatchers) currently subscribed to jax.monitoring
+# events. Weak so a log that was never closed (crashed run) doesn't stay
+# pinned by the listener. Mutated only under _registry_lock: subscribers
+# come and go from arbitrary threads while the listener fans out.
+_registry_lock = threading.Lock()
 _open_logs = weakref.WeakSet()
+_compile_watchers = weakref.WeakSet()
 _listener_registered = False
+
+# jax.monitoring event-name fragments that mark ONE program being built
+# (the retrace signal: a jit cache hit emits none of these).
+COMPILE_EVENT_MARKERS = ("backend_compile",)
 
 
 def _ensure_monitoring_listener():
     """Register the ONE process-wide jax.monitoring duration listener
     (registration is append-only in jax — there is no unregister)."""
     global _listener_registered
-    if _listener_registered:
-        return
     try:
         from jax import monitoring
     except Exception:
         return
 
     def _listener(event, secs, **kw):
-        for log in list(_open_logs):
+        # snapshot under the same lock the writers take: WeakSet
+        # iteration races with add/discard from other threads otherwise
+        with _registry_lock:
+            logs = list(_open_logs)
+            watchers = list(_compile_watchers)
+        for log in logs:
             log._on_monitoring_event(event, secs)
+        for watcher in watchers:
+            watcher._on_monitoring_event(event, secs)
 
+    with _registry_lock:
+        if _listener_registered:
+            return
+        try:
+            monitoring.register_event_duration_secs_listener(_listener)
+            _listener_registered = True
+        except Exception:
+            pass
+
+
+class CompileWatcher:
+    """Counts program compilations via the monitoring listener
+    (``COMPILE_EVENT_MARKERS`` events). The backing object of
+    :func:`watch_compiles` and the analyze retrace budget."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compiles = 0
+        self.events = []
+
+    def _on_monitoring_event(self, event, secs):
+        name = str(event)
+        if any(marker in name for marker in COMPILE_EVENT_MARKERS):
+            with self._lock:
+                self.compiles += 1
+                self.events.append(name)
+
+
+@contextlib.contextmanager
+def watch_compiles():
+    """Context manager counting programs compiled inside the block —
+    process-wide (any thread), cache hits free. Yields the
+    :class:`CompileWatcher`; read ``.compiles`` after (or during) the
+    block. Used by ``paddle_tpu.analyze.max_retraces`` to pin the
+    jit-entry predictions of the topology checker."""
+    _ensure_monitoring_listener()
+    watcher = CompileWatcher()
+    with _registry_lock:
+        _compile_watchers.add(watcher)
     try:
-        monitoring.register_event_duration_secs_listener(_listener)
-        _listener_registered = True
-    except Exception:
-        pass
+        yield watcher
+    finally:
+        with _registry_lock:
+            _compile_watchers.discard(watcher)
 
 
 def telemetry_dir():
@@ -199,7 +252,8 @@ class StepLog:
         logs (weakly held, dropped on close) — constructing many StepLogs
         in one process must not accumulate dead listeners."""
         _ensure_monitoring_listener()
-        _open_logs.add(self)
+        with _registry_lock:
+            _open_logs.add(self)
 
     def _on_monitoring_event(self, event, secs):
         if self._closed:
@@ -390,7 +444,8 @@ class StepLog:
         self.write(rec)
 
     def close(self):
-        _open_logs.discard(self)
+        with _registry_lock:
+            _open_logs.discard(self)
         with self._lock:
             if self._closed:
                 return

@@ -188,9 +188,12 @@ class Bundle:
 
     def run(self, flat_inputs, batch):
         """Run one exact-bucket batch (no padding logic). Returns
-        {output_name: np.ndarray}."""
+        {output_name: np.ndarray} — THE sanctioned readback point of
+        the serving path: callers get host arrays by contract, and the
+        engine wraps this call in its ``serve_batch`` span."""
         out = self.executable(batch).call(self._params, flat_inputs)
-        return {k: np.asarray(v) for k, v in out.items()}
+        return {k: np.asarray(v)  # paddle-lint: disable=PTA001
+                for k, v in out.items()}
 
     def infer(self, flat_inputs, rows=None):
         """Pad ``flat_inputs`` to the nearest exported bucket, run, slice
@@ -205,7 +208,7 @@ class Bundle:
         padded = {k: pad_rows(np.asarray(v), bucket["batch"])
                   for k, v in flat_inputs.items()}
         out = self.run(padded, bucket["batch"])
-        return {k: v[:rows] for k, v in out.items()}
+        return {k: arr[:rows] for k, arr in out.items()}
 
     def __repr__(self):
         return "Bundle(%r, buckets=%s, inputs=%s)" % (

@@ -94,10 +94,15 @@ class Topology:
         # f32 and should see full-precision targets.
         from paddle_tpu.layer.cost import COST_LAYER_TYPES
 
+        # reverse edges, kept public: {producer name: [(consumer node,
+        # input position)]} — the static analyzers (analyze/
+        # topology_check.py) and the label-feed classification below
+        # both walk the graph consumer-side
         consumers = {}
         for node in self.nodes:
             for pos, parent in enumerate(node.inputs):
                 consumers.setdefault(parent.name, []).append((node, pos))
+        self.consumers = consumers
         self._label_feed_names = {
             name for name in self.data_layers
             if consumers.get(name)

@@ -298,6 +298,13 @@ class SGD:
                     "steps_per_call requires a parallelism with a "
                     "shard_train_chunk wrapper (%s has none)",
                     type(self.parallelism).__name__)
+        if os.environ.get("PADDLE_TPU_ANALYZE"):
+            # pre-compile static checks (docs/analyze.md): packing
+            # legality, dtype hazards, donation conflicts — warnings
+            # log, errors raise before the first dispatch
+            from paddle_tpu.analyze.topology_check import pretrain_check
+
+            pretrain_check(self, steps_per_call=k or None)
         log_period = flags.get_flag("log_period")
         test_period = flags.get_flag("test_period")
 
@@ -428,7 +435,7 @@ class SGD:
                     pass_id, b_id, gm=self))
                 if log_period and b_id % log_period == 0:
                     logger.info("pass %d batch %d cost=%.6f %s", pass_id,
-                                b_id, float(loss), _fmt_metrics(metrics))
+                                b_id, loss, _fmt_metrics(metrics))
                     if flags.get_flag("show_layer_stat"):
                         self._log_layer_stats(feed)
                 psp = flags.get_flag("show_parameter_stats_period")
@@ -445,7 +452,7 @@ class SGD:
                     # wall_ms interval
                     last_final["t"] = time.perf_counter()
                 event_handler(v2_event.EndIteration(
-                    pass_id, b_id, float(loss), metrics))
+                    pass_id, b_id, loss, metrics))
 
             self._pass_step_base = self._step_count
             if not feed_pipeline:

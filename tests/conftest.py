@@ -39,12 +39,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import pytest
 
+# Dynamic analysis gates (docs/analyze.md): the autouse thread-leak
+# gate and the max_retraces compile-budget fixture apply to the WHOLE
+# tier-1 suite. Imported into this namespace (rather than listed in
+# pytest_plugins) so registration works from a non-rootdir conftest.
+from paddle_tpu.analyze.pytest_plugin import (  # noqa: F401
+    _max_retraces_fixture,
+    _thread_leak_gate,
+)
+from paddle_tpu.analyze.pytest_plugin import (
+    pytest_configure as _analyze_configure,
+)
+
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: subprocess-heavy tests excluded from the tier-1 run "
         "(-m 'not slow'); run them with -m slow")
+    _analyze_configure(config)
 
 
 @pytest.fixture(autouse=True)
