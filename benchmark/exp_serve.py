@@ -1,27 +1,34 @@
-"""Serving throughput/latency experiment over the paddle_tpu.serve
-engine (docs/serving.md).
+"""Serving throughput/latency experiments over the paddle_tpu.serve
+tier (docs/serving.md).
 
-Exports the dense-MNIST MLP demo bundle into a scratch directory (or
-takes ``--bundle`` for a pre-exported one), fronts it with the
-dynamic-batching engine, and drives it with N concurrent closed-loop
-submitters for a fixed request count. Emits ONE audited JSON row:
+Three modes, all emitting audited JSON rows through
+``benchmark.harness.sanitize_bench_row`` (serving invariants: p99 < p50
+or qps <= 0 REJECT the row), mirrored into telemetry as ``bench_row``
+when PADDLE_TPU_TELEMETRY is set, and gated against the checked-in
+audited set via ``observe/regress.py`` (warn-only by default,
+``PADDLE_TPU_BENCH_GATE=hard`` fails):
 
-    {"metric": "serve_mlp_qps_c8", "value": <qps>, "unit": "qps",
-     "p50_ms": ..., "p99_ms": ..., "requests": ..., "batches": ...,
-     "max_batch": ..., "max_latency_ms": ..., "clients": ...}
-
-Every row passes ``benchmark.harness.sanitize_bench_row`` (serving
-invariants: a row with p99 < p50 or qps <= 0 is REJECTED — such a row
-can only come from broken measurement, tests/test_bench_rows.py) and is
-mirrored into the telemetry steplog as ``bench_row`` when
-PADDLE_TPU_TELEMETRY is set, the same contract as benchmark/run.py.
-The per-batch ``serve_batch`` records ride the engine's own steplog in
-the same telemetry dir, so the row and the batch trace can't disagree.
+* ``--mode closed`` (default) — the PR 3 closed-loop MLP measurement:
+  N concurrent submitters against the dynamic-batching engine.
+* ``--mode openloop-ab`` — the continuous-batching acceptance A/B: ONE
+  fixed-seed open-loop arrival trace (Poisson arrivals at
+  ``--arrival-qps``, heavy-tailed lognormal lengths — the skewed load
+  where whole-request batching drowns in padding) replayed against
+  (a) the whole-request engine padding every sequence to the exported
+  seq_len, and (b) the continuous-batching scheduler streaming the
+  same recurrent bundle through its slot matrix. Gates asserted BEFORE
+  any row emits: sustained qps >= ``--min-speedup`` x the baseline
+  (default 3.0) at equal-or-better p99.
+* ``--mode priority`` — the mixed two-model shed run: a high-priority
+  model at a sustainable rate plus a low-priority flood through one
+  Router. Gates: the LOW model sheds (>0, counted in metrics +
+  ``serve_shed`` records), the HIGH model sheds nothing, and the high
+  p99 under the flood stays within ``--p99-tol-pct`` of its solo run.
 
 Usage:
-  python benchmark/exp_serve.py                       # export + measure
-  python benchmark/exp_serve.py --clients 16 --requests 800
-  python benchmark/exp_serve.py --bundle /path/to/bundle
+  python benchmark/exp_serve.py                       # closed-loop MLP
+  python benchmark/exp_serve.py --mode openloop-ab
+  python benchmark/exp_serve.py --mode priority
 """
 
 import argparse
@@ -52,6 +59,23 @@ def _export_demo_bundle(out_dir, batch_sizes):
     return out_dir
 
 
+def _export_tagger_bundle(out_dir, batch_sizes, seq_len, slots, window,
+                          hidden, name="tagger"):
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.text import sequence_tagging_gru
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = sequence_tagging_gru(dict_size=1000, label_size=32,
+                               emb_size=32, hidden=hidden)
+    params = Parameters.create(out)
+    export_bundle(out, params, out_dir, batch_sizes=batch_sizes,
+                  seq_len=seq_len, name=name, decode_slots=(slots,),
+                  decode_window=window)
+    return out_dir
+
+
 def measure(bundle_dir, clients, requests, rows_per_request,
             max_latency_ms):
     from paddle_tpu.serve import InferenceEngine, load_bundle
@@ -77,7 +101,8 @@ def measure(bundle_dir, clients, requests, rows_per_request,
         with lat_lock:
             latencies.extend(mine)
 
-    threads = [threading.Thread(target=client, args=(c,))
+    threads = [threading.Thread(target=client, args=(c,),
+                                name="serve-bench-client-%d" % c)
                for c in range(clients)]
     t_start = time.perf_counter()
     for t in threads:
@@ -104,21 +129,327 @@ def measure(bundle_dir, clients, requests, rows_per_request,
     }
 
 
+# -- open-loop machinery -----------------------------------------------------
+
+def arrival_trace(requests, qps, seed, mean_len, seq_len, vocab=1000):
+    """ONE reproducible open-loop load: Poisson arrival offsets (s) and
+    heavy-tailed (lognormal sigma=0.8) sequence lengths in
+    [1, seq_len]. The same (seed, requests, qps, mean_len) always
+    replays the same trace — A and B see identical work."""
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / float(qps),
+                                         size=requests))
+    lengths = np.clip(
+        np.rint(rng.lognormal(np.log(mean_len), 0.8, size=requests)),
+        1, seq_len).astype(np.int64)
+    seqs = [rng.randint(0, vocab, size=(int(k),)).astype(np.int32)
+            for k in lengths]
+    return arrivals, seqs
+
+
+def drive_open_loop(submit_fn, arrivals):
+    """Replay an open-loop schedule: request i is dispatched at
+    ``arrivals[i]`` seconds after start REGARDLESS of completions (the
+    no-coordinated-omission convention: latency counts from the
+    SCHEDULED arrival, so queueing delay is charged to the system, not
+    hidden by a slow client). Returns (latencies_ms, wall_s, shed)."""
+    from paddle_tpu.serve import Overloaded
+
+    t0 = time.perf_counter()
+    lock = threading.Lock()
+    latencies, completions = [], []
+    futures = []
+    shed = 0
+    i = 0
+    n = len(arrivals)
+    while i < n:
+        now = time.perf_counter() - t0
+        # submit EVERY due request, then sleep one coarse tick: per-
+        # request sleeps would wake 1000+/s against the serving
+        # worker's GIL and throttle the offered rate below schedule
+        while i < n and arrivals[i] <= now:
+            t_arr = arrivals[i]
+            try:
+                fut = submit_fn(i)
+            except Overloaded:
+                shed += 1
+                i += 1
+                continue
+
+            def _done(f, t_sched=float(t_arr)):
+                t_c = time.perf_counter() - t0
+                with lock:
+                    completions.append(t_c)
+                    latencies.append((t_c - t_sched) * 1e3)
+
+            fut.add_done_callback(_done)
+            futures.append(fut)
+            i += 1
+        if i < n:
+            time.sleep(min(max(arrivals[i] - (time.perf_counter() - t0),
+                               0.0), 0.005))
+    for fut in futures:
+        fut.result(timeout=600.0)
+    with lock:
+        wall_s = max(completions) if completions else 0.0
+        lat = list(latencies)
+    return lat, wall_s, shed
+
+
+def _percentiles(lat):
+    lat = np.asarray(lat)
+    return (round(float(np.percentile(lat, 50)), 3),
+            round(float(np.percentile(lat, 99)), 3))
+
+
+def measure_openloop_ab(args):
+    """The continuous-batching acceptance A/B on one recurrent bundle
+    under one skewed open-loop trace."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import (ContinuousScheduler, InferenceEngine,
+                                  load_bundle)
+
+    bundle_dir = args.bundle or _export_tagger_bundle(
+        tempfile.mkdtemp(prefix="serve_tagger_"),
+        tuple(int(b) for b in args.batch_sizes.split(",")),
+        args.seq_len, args.decode_slots, args.decode_window, args.hidden)
+    bundle = load_bundle(bundle_dir)
+    seq_len = bundle.seq_len
+    arrivals, seqs = arrival_trace(args.requests, args.arrival_qps,
+                                   args.seed, args.mean_len, seq_len)
+
+    # A: whole-request batching — every sequence pads to seq_len
+    engine = InferenceEngine(bundle, max_latency_ms=args.max_latency_ms,
+                             metrics_registry=MetricsRegistry(),
+                             model="tagger_batch")
+    padded = []
+    for s in seqs:
+        ids = np.zeros((1, seq_len), np.int32)
+        ids[0, :len(s)] = s
+        padded.append({"word": ids,
+                       "word:lens": np.array([len(s)], np.int32)})
+    lat_a, wall_a, _ = drive_open_loop(
+        lambda i: engine.submit(padded[i]), arrivals)
+    engine.stop()
+
+    # B: continuous batching — the same trace through the slot matrix
+    sched = ContinuousScheduler(bundle, metrics_registry=MetricsRegistry(),
+                                model="tagger_cont", max_queue=None)
+    lat_b, wall_b, _ = drive_open_loop(
+        lambda i: sched.submit({"word": seqs[i]}), arrivals)
+    cont_stats = sched.stats()
+    sched.stop()
+
+    qps_a, qps_b = len(lat_a) / wall_a, len(lat_b) / wall_b
+    p50_a, p99_a = _percentiles(lat_a)
+    p50_b, p99_b = _percentiles(lat_b)
+    speedup = qps_b / qps_a
+
+    # the acceptance gates run BEFORE any row emits: a failed gate
+    # publishes nothing
+    if args.min_speedup > 0:
+        assert speedup >= args.min_speedup, (
+            "continuous batching gate FAILED: %.2fx sustained qps "
+            "(%.1f vs %.1f), need >= %.1fx"
+            % (speedup, qps_b, qps_a, args.min_speedup))
+        assert p99_b <= p99_a, (
+            "continuous batching gate FAILED: p99 %.1fms worse than "
+            "whole-request %.1fms" % (p99_b, p99_a))
+
+    base = {
+        "unit": "qps", "requests": args.requests,
+        "offered_qps": args.arrival_qps, "seed": args.seed,
+        "mean_len": args.mean_len, "seq_len": seq_len,
+        "arrivals": "poisson", "lengths": "lognormal_s0.8",
+    }
+    row_a = dict(base, metric="serve_batch_tagger_qps",
+                 value=round(qps_a, 2), p50_ms=p50_a, p99_ms=p99_a,
+                 wall_s=round(wall_a, 3), mode="whole_request")
+    row_b = dict(base, metric="serve_cont_tagger_qps",
+                 value=round(qps_b, 2), p50_ms=p50_b, p99_ms=p99_b,
+                 wall_s=round(wall_b, 3), mode="continuous",
+                 slots=cont_stats["slots"], window=cont_stats["window"],
+                 iterations=cont_stats["iterations"],
+                 slot_steps=cont_stats["slot_steps"],
+                 speedup_vs_batch=round(speedup, 2))
+    return [row_a, row_b]
+
+
+def measure_priority(args):
+    """The mixed two-model shed run: high-priority MLP at a sustainable
+    rate, low-priority MLP flooded, one Router. Only low may shed; the
+    high p99 must hold vs its solo run."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine, Router, load_bundle
+
+    high_dir = _export_demo_bundle(
+        tempfile.mkdtemp(prefix="serve_high_"), (1, 8))
+    low_dir = _export_demo_bundle(
+        tempfile.mkdtemp(prefix="serve_low_"), (1, 8))
+    high_bundle, low_bundle = load_bundle(high_dir), load_bundle(low_dir)
+    rng = np.random.RandomState(args.seed)
+    payload = {"pixel": rng.randn(1, 784).astype(np.float32)}
+    n_high = args.requests
+    high_arrivals = np.cumsum(rng.exponential(
+        1.0 / args.high_qps, size=n_high))
+
+    def run_high(router):
+        return drive_open_loop(
+            lambda i: router.submit("high", dict(payload)),
+            high_arrivals)
+
+    def build_router(reg, with_low):
+        router = Router(metrics_registry=reg,
+                        shed_capacity={"high": None, "low": 64})
+        router.add_model(
+            "high", high_bundle,
+            InferenceEngine(high_bundle, max_latency_ms=2.0,
+                            metrics_registry=reg, model="high"),
+            priority="high")
+        if with_low:
+            router.add_model(
+                "low", low_bundle,
+                InferenceEngine(low_bundle, max_latency_ms=2.0,
+                                metrics_registry=reg, model="low",
+                                max_queue_rows=32),
+                priority="low")
+        return router
+
+    # solo baseline: high alone on the same schedule
+    with build_router(MetricsRegistry(), with_low=False) as router:
+        lat_solo, _, _ = run_high(router)
+    p50_solo, p99_solo = _percentiles(lat_solo)
+
+    # mixed: the low-priority flood runs concurrently
+    reg = MetricsRegistry()
+    with build_router(reg, with_low=True) as router:
+        n_low = args.requests * 4
+        low_arrivals = np.cumsum(np.random.RandomState(args.seed + 1)
+                                 .exponential(1.0 / args.low_qps,
+                                              size=n_low))
+        low_result = {}
+
+        def flood_low():
+            low_result["res"] = drive_open_loop(
+                lambda i: router.submit("low", dict(payload)),
+                low_arrivals)
+
+        flooder = threading.Thread(target=flood_low,
+                                   name="serve-bench-low-flood")
+        flooder.start()
+        lat_mixed, _, high_shed = run_high(router)
+        flooder.join()
+    _, _, low_shed = low_result["res"]
+    p50_mixed, p99_mixed = _percentiles(lat_mixed)
+    snap = reg.snapshot()["counters"]
+    low_shed_counted = sum(v for k, v in snap.items()
+                           if k.startswith("paddle_tpu_serve_shed_total")
+                           and 'model="low"' in k)
+
+    # gates BEFORE any row emits
+    assert low_shed > 0 and low_shed_counted >= low_shed, (
+        "priority gate FAILED: the low-priority flood shed nothing "
+        "(%d submitted)" % n_low)
+    assert high_shed == 0, (
+        "priority gate FAILED: %d high-priority sheds" % high_shed)
+    tol = 1.0 + args.p99_tol_pct / 100.0
+    assert p99_mixed <= p99_solo * tol, (
+        "priority gate FAILED: high p99 %.1fms under flood vs %.1fms "
+        "solo (tolerance %.0f%%)" % (p99_mixed, p99_solo,
+                                     args.p99_tol_pct))
+
+    return [{
+        "metric": "serve_priority_high_qps",
+        "value": round(len(lat_mixed)
+                       / (high_arrivals[-1] + 1e-9), 2),
+        "unit": "qps",
+        "p50_ms": p50_mixed, "p99_ms": p99_mixed,
+        "solo_p50_ms": p50_solo, "solo_p99_ms": p99_solo,
+        "requests": n_high, "offered_qps": args.high_qps,
+        "low_offered_qps": args.low_qps,
+        "low_requests": n_low, "low_shed": int(low_shed),
+        "low_shed_pct": round(100.0 * low_shed / n_low, 2),
+        "high_shed": int(high_shed), "seed": args.seed,
+    }]
+
+
+def _emit(rows, slog_name):
+    """sanitize -> print -> regress-gate -> telemetry-mirror, the
+    audited-row contract every bench shares."""
+    from benchmark.harness import sanitize_bench_row
+    from paddle_tpu.observe import regress as observe_regress
+    from paddle_tpu.observe import steplog as observe_steplog
+
+    rows = [sanitize_bench_row(row) for row in rows]
+    for row in rows:
+        print(json.dumps(row))
+    results, regressions = observe_regress.gate_rows(rows)
+    for res in results:
+        if res["status"] in ("regression", "ok"):
+            print(json.dumps({"regress_note":
+                              observe_regress.format_result(res)}))
+    slog = observe_steplog.from_env(run_name=slog_name,
+                                    meta={"phase": "bench"})
+    if slog is not None:
+        for row in rows:
+            slog.write(dict(row, type="bench_row"))
+        slog.close()
+    if regressions and observe_regress.hard_gate():
+        print("bench regression gate: FAILED (%d gated)"
+              % len(regressions), file=sys.stderr)
+        return 3
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", default="closed",
+                    choices=("closed", "openloop-ab", "priority"))
     ap.add_argument("--bundle", default="",
                     help="pre-exported bundle dir (default: export the "
-                         "dense-MNIST MLP demo bundle to a tmp dir)")
+                         "mode's demo bundle to a tmp dir)")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--requests", type=int, default=400)
     ap.add_argument("--rows-per-request", type=int, default=1)
     ap.add_argument("--max-latency-ms", type=float, default=5.0)
     ap.add_argument("--batch-sizes", default="1,8,32")
+    # open-loop / priority knobs
+    ap.add_argument("--arrival-qps", type=float, default=2400.0,
+                    help="open-loop offered rate (Poisson; the default "
+                         "saturates both systems so sustained qps is "
+                         "the capacity, not the offered rate)")
+    ap.add_argument("--high-qps", type=float, default=300.0,
+                    help="priority mode: high-priority offered rate "
+                         "(sustainable — its p99 is the thing under "
+                         "test)")
+    ap.add_argument("--low-qps", type=float, default=6000.0,
+                    help="priority mode: low-priority flood rate (well "
+                         "past the low model's capacity, so its bounded "
+                         "queue must shed)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="arrival-trace seed (reproducible rows)")
+    ap.add_argument("--mean-len", type=float, default=8.0,
+                    help="lognormal median sequence length (the heavy "
+                         "tail runs to ~p999 of the distribution; "
+                         "seq_len must cover it)")
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--decode-slots", type=int, default=48)
+    ap.add_argument("--decode-window", type=int, default=6)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--min-speedup", type=float, default=3.0,
+                    help="openloop-ab gate: continuous must sustain "
+                         ">= this x the whole-request qps (0 disables)")
+    ap.add_argument("--p99-tol-pct", type=float, default=50.0,
+                    help="priority gate: high p99 under flood vs solo")
     args = ap.parse_args(argv)
 
-    from benchmark.harness import enable_compile_cache, sanitize_bench_row
+    from benchmark.harness import enable_compile_cache
 
     enable_compile_cache()
+    if args.mode == "openloop-ab":
+        return _emit(measure_openloop_ab(args), "exp_serve_openloop")
+    if args.mode == "priority":
+        return _emit(measure_priority(args), "exp_serve_priority")
     bundle_dir = args.bundle
     if not bundle_dir:
         bundle_dir = _export_demo_bundle(
@@ -128,18 +459,7 @@ def main(argv=None):
                           "bundle": bundle_dir}))
     row = measure(bundle_dir, args.clients, args.requests,
                   args.rows_per_request, args.max_latency_ms)
-    row = sanitize_bench_row(row)  # raises on p99<p50 / qps<=0: never
-    # publish a serving row the invariants reject
-    print(json.dumps(row))
-
-    from paddle_tpu.observe import steplog as observe_steplog
-
-    slog = observe_steplog.from_env(run_name="exp_serve",
-                                    meta={"phase": "bench"})
-    if slog is not None:
-        slog.write(dict(row, type="bench_row"))
-        slog.close()
-    return 0
+    return _emit([row], "exp_serve")
 
 
 if __name__ == "__main__":

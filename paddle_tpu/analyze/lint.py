@@ -77,13 +77,17 @@ CHECKERS = {
 HOT_PATHS = {
     "trainer.py": {"_train_passes", "_train_passes_fused", "test"},
     "serve/engine.py": {"submit", "_take_batch", "_loop", "_run_batch"},
-    "serve/bundle.py": {"run", "infer", "warmup"},
+    "serve/bundle.py": {"run", "infer", "warmup", "decode_step"},
+    "serve/scheduler.py": {"submit", "_loop", "_run_iteration",
+                           "_distribute", "_admit"},
+    "serve/router.py": {"submit", "total_queued"},
     "data/feeder.py": {"_produce", "batches", "chunks"},
 }
 
 # Calls whose results are device-resident values: reading them back with
 # float()/np.asarray() outside a span is the PTA001 hazard.
-DEVICE_CALLS = {"_train_step", "_train_chunk", "_eval_step", "call", "run"}
+DEVICE_CALLS = {"_train_step", "_train_chunk", "_eval_step", "call", "run",
+                "decode_step"}
 
 # Host-materializing wrappers that flag when applied to a device value.
 SYNC_WRAPPERS = {"float", "int", "asarray", "array", "atleast_1d"}

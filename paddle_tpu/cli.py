@@ -314,11 +314,17 @@ def cmd_export(args):
     with open(args.params, "rb") as f:
         params = Parameters.from_tar(f)
     batch_sizes = tuple(int(b) for b in args.batch_sizes.split(",") if b)
+    decode_slots = tuple(int(s) for s in
+                         getattr(args, "decode_slots", "").split(",")
+                         if s) or None
     manifest = export_bundle(outputs, params, args.output,
                              batch_sizes=batch_sizes,
                              seq_len=args.seq_len, name=args.name or None,
                              platforms=(args.platforms.split(",")
-                                        if args.platforms else None))
+                                        if args.platforms else None),
+                             decode_slots=decode_slots,
+                             decode_window=getattr(args, "decode_window",
+                                                   None))
     import jax
 
     if jax.default_backend() in manifest["platforms"]:
@@ -326,29 +332,100 @@ def cmd_export(args):
         # run HERE (cross-platform exports can only be checked on their
         # target backend — `cli serve --selfcheck` there)
         verify_bundle(args.output)
-    print(json.dumps({"bundle": args.output,
-                      "name": manifest["name"],
-                      "buckets": [b["batch"] for b in manifest["buckets"]],
-                      "inputs": [i["name"] for i in manifest["inputs"]],
-                      "platforms": manifest["platforms"]}))
+    summary = {"bundle": args.output,
+               "name": manifest["name"],
+               "buckets": [b["batch"] for b in manifest["buckets"]],
+               "inputs": [i["name"] for i in manifest["inputs"]],
+               "platforms": manifest["platforms"]}
+    if manifest.get("decode"):
+        summary["decode_slots"] = [b["slots"] for b in
+                                   manifest["decode"]["slots"]]
+        summary["decode_window"] = manifest["decode"]["window"]
+    print(json.dumps(summary))
     return 0
 
 
-def cmd_serve(args):
-    """Serve an exported bundle behind the dynamic-batching engine.
-    ``--selfcheck`` loads the bundle, warms every bucket, pushes one
-    batch through the engine and exits — the deployment smoke gate
-    (tests/test_serve.py uses it the same way CI would)."""
-    from paddle_tpu.serve import InferenceEngine, load_bundle
+def _make_engine(bundle, args, reg, model=None, warmup="async"):
+    from paddle_tpu.serve import ContinuousScheduler, InferenceEngine
 
+    if args.continuous:
+        if not bundle.has_decoder():
+            # refuse loudly: silently falling back to the padding
+            # engine would leave the operator believing continuous
+            # batching is active
+            print("--continuous: bundle %r has no decode artifacts; "
+                  "re-export with --decode-slots" % bundle.name,
+                  file=sys.stderr)
+            raise SystemExit(2)
+        return ContinuousScheduler(
+            bundle, warmup=warmup, metrics_registry=reg, model=model,
+            max_queue=args.max_queue_rows)
+    return InferenceEngine(
+        bundle, max_batch_size=args.max_batch_size,
+        max_latency_ms=args.max_latency_ms, warmup=warmup,
+        metrics_registry=reg, model=model,
+        max_queue_rows=args.max_queue_rows)
+
+
+def cmd_serve(args):
+    """Serve exported bundles behind the serving tier. Single-model:
+    ``cli serve <bundle>`` (the PR 3 surface, plus ``--continuous`` for
+    decode-capable bundles). Multi-model: repeat ``--model
+    NAME=DIR[:PRIORITY]`` to host N bundles behind the router —
+    per-model queues, priority admission control, 429 load shedding,
+    per-model ``/readyz``. ``--selfcheck`` loads the bundle, warms
+    every bucket, pushes one batch through the engine and exits — the
+    deployment smoke gate (tests/test_serve.py uses it the same way CI
+    would)."""
+    from paddle_tpu.observe import metrics as observe_metrics
+    from paddle_tpu.serve import Router, load_bundle
+
+    if args.model:
+        if args.bundle or args.selfcheck:
+            print("--model is multi-model mode: drop the positional "
+                  "bundle / --selfcheck", file=sys.stderr)
+            return 2
+        from paddle_tpu.serve.server import make_router_server
+
+        reg = observe_metrics.get_registry()
+        router = Router(metrics_registry=reg)
+        for spec in args.model:
+            name, _, rest = spec.partition("=")
+            if not rest:
+                print("--model wants NAME=DIR[:PRIORITY], got %r" % spec,
+                      file=sys.stderr)
+                return 2
+            directory, _, priority = rest.rpartition(":")
+            if not directory:  # no priority suffix
+                directory, priority = rest, "normal"
+            bundle = load_bundle(directory)
+            router.add_model(name, bundle,
+                             _make_engine(bundle, args, reg, model=name),
+                             priority=priority or "normal")
+        server = make_router_server(router, host=args.host,
+                                    port=args.port)
+        print("serving %s on http://%s:%d (POST /infer/<model>; GET "
+              "/healthz /readyz /metrics /stats /manifest/<model>)"
+              % (sorted(router.models()), *server.server_address))
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.shutdown()
+            router.stop()
+        return 0
+    if not args.bundle:
+        print("serve needs a bundle directory or --model entries",
+              file=sys.stderr)
+        return 2
     bundle = load_bundle(args.bundle)
     # serving path: warm asynchronously so the HTTP endpoints bind
     # immediately and the readiness probe (/healthz, /readyz) honestly
     # reports ready=false until every bucket is warm; selfcheck warms
     # synchronously — it IS the warmth gate
-    engine = InferenceEngine(bundle, max_batch_size=args.max_batch_size,
-                             max_latency_ms=args.max_latency_ms,
-                             warmup=(True if args.selfcheck else "async"))
+    engine = _make_engine(bundle, args, observe_metrics.get_registry(),
+                          warmup=(True if args.selfcheck else "async"))
     if args.selfcheck:
         try:
             out = engine.infer(bundle.dummy_inputs(rows=1), timeout=300.0)
@@ -656,16 +733,36 @@ def main(argv=None):
     p.add_argument("--name", default="")
     p.add_argument("--platforms", default="",
                    help="comma-separated lowering platforms (e.g. cpu,tpu)")
+    p.add_argument("--decode-slots", default="",
+                   help="comma-separated slot capacities: additionally "
+                        "export continuous-batching decode steps "
+                        "(streamable recurrent topologies only)")
+    p.add_argument("--decode-window", type=int, default=None,
+                   help="decode timesteps per dispatch (default 8)")
     p.set_defaults(fn=cmd_export)
 
     p = sub.add_parser("serve")
-    p.add_argument("bundle", help="exported bundle directory")
+    p.add_argument("bundle", nargs="?", default="",
+                   help="exported bundle directory (single-model mode)")
+    p.add_argument("--model", action="append", default=[],
+                   metavar="NAME=DIR[:PRIORITY]",
+                   help="host NAME from bundle DIR with an optional "
+                        "priority class (high/normal/low); repeat for "
+                        "multi-model serving behind the router "
+                        "(POST /infer/<name>, per-model /readyz)")
+    p.add_argument("--continuous", action="store_true",
+                   help="front decode-capable bundles with the "
+                        "continuous-batching scheduler instead of the "
+                        "whole-request batcher")
     p.add_argument("--selfcheck", action="store_true",
                    help="load, warm, run one batch, exit (smoke gate)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8866)
     p.add_argument("--max-batch-size", type=int, default=None)
     p.add_argument("--max-latency-ms", type=float, default=5.0)
+    p.add_argument("--max-queue-rows", type=int, default=None,
+                   help="bound each hosted queue; a full queue answers "
+                        "429 instead of queueing (load shedding)")
     p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)

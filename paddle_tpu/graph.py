@@ -123,6 +123,15 @@ class Context:
         self._rng_counter = itertools.count()
         self.state_updates = {}
         self.aux = {}
+        # streaming-decode carry threading (serve/export.py decode step):
+        # when ``decode_state`` is a dict, recurrent layers read their
+        # initial carry from it (decode_state[layer_name] = [leaf, ...];
+        # missing = zeros) and write their final carry to
+        # ``decode_state_out`` — the serving scheduler threads the carry
+        # across window dispatches so sequences stream through a
+        # fixed-capacity slot matrix (docs/serving.md).
+        self.decode_state = None
+        self.decode_state_out = None
 
     @property
     def is_train(self):

@@ -27,21 +27,44 @@ from paddle_tpu.ops import rnn as rnn_ops
 from paddle_tpu.utils.error import enforce
 
 
-def _run_seq_scan(x, inp, reverse, scan_fn):
+def _run_seq_scan(x, inp, reverse, scan_fn, ctx=None, name=None):
     """Run a masked recurrent scan over a (possibly packed) sequence
     input ``x`` whose (bias-adjusted) projection is ``inp``.
 
-    ``scan_fn(data, reset_bt, reverse) -> h_seq [B, T, H]``. Plain
-    SequenceBatch: the scan handles ``reverse`` itself (unchanged fast
-    path, fused kernels eligible). PackedSequenceBatch: the carry resets
-    at segment starts (ops/rnn.py ``reset_bt``) and reverse
-    pre/post-reverses PER SEGMENT (PackedSequenceBatch.reverse), so a
-    packed row computes exactly what its unpacked sequences would."""
+    ``scan_fn(data, reset_bt, reverse, state) -> (h_seq [B, T, H],
+    [final carry leaf, ...])``. Plain SequenceBatch: the scan handles
+    ``reverse`` itself (unchanged fast path, fused kernels eligible).
+    PackedSequenceBatch: the carry resets at segment starts (ops/rnn.py
+    ``reset_bt``) and reverse pre/post-reverses PER SEGMENT
+    (PackedSequenceBatch.reverse), so a packed row computes exactly what
+    its unpacked sequences would.
+
+    Streaming decode (``ctx.decode_state`` is a dict —
+    Topology.apply_decode): the scan boots from the threaded carry
+    (``decode_state[name]``, zeros when absent) and the final carry is
+    written to ``ctx.decode_state_out[name]`` so the serving scheduler
+    can continue the sequence in the next window dispatch. Because the
+    scan is masked, idle slots (length 0 this window) pass their carry
+    through untouched. Reverse layers read future timesteps and cannot
+    stream — they refuse decode mode loudly."""
+    dstate = getattr(ctx, "decode_state", None)
+    if dstate is not None:
+        enforce(not reverse,
+                "reverse recurrent layer %r cannot stream: a "
+                "right-to-left scan reads future timesteps the decode "
+                "window has not seen yet", name)
+        enforce(not isinstance(x, PackedSequenceBatch),
+                "streaming decode over packed rows is unsupported "
+                "(layer %r): the slot matrix IS the packing", name)
+        h_seq, final = scan_fn(inp, None, False, dstate.get(name))
+        ctx.decode_state_out[name] = list(final)
+        return SequenceBatch(h_seq, x.lengths)
     if not isinstance(x, PackedSequenceBatch):
-        return SequenceBatch(scan_fn(inp, None, reverse), x.lengths)
+        h_seq, _ = scan_fn(inp, None, reverse, None)
+        return SequenceBatch(h_seq, x.lengths)
     px = PackedSequenceBatch(inp, x.lengths, x.segments)
     data = px.reverse().data if reverse else px.data
-    h_seq = scan_fn(data, px.reset_mask(), False)
+    h_seq, _ = scan_fn(data, px.reset_mask(), False, None)
     out = PackedSequenceBatch(h_seq, x.lengths, x.segments)
     return out.reverse() if reverse else out
 
@@ -131,13 +154,15 @@ def lstmemory(input, name=None, size=None, reverse=False, act=None,
             gates = gates + bias[: 4 * size]
             if peephole:
                 w_peep = bias[4 * size:]
-        def scan_fn(data, reset_bt, rev):
-            h_seq, _ = rnn_ops.lstm_scan(
+        def scan_fn(data, reset_bt, rev, state):
+            h_seq, (h_f, c_f) = rnn_ops.lstm_scan(
                 data,
                 x.mask(gates.dtype),
                 w_in=None,
                 b=None,
                 w_rec=params[wspec.name],
+                h0=None if state is None else state[0],
+                c0=None if state is None else state[1],
                 gate_act=g_act,
                 state_act=s_act,
                 reverse=rev,
@@ -147,9 +172,9 @@ def lstmemory(input, name=None, size=None, reverse=False, act=None,
                 out_act=o_act,
                 reset_bt=reset_bt,
             )
-            return h_seq
+            return h_seq, [h_f, c_f]
 
-        return _run_seq_scan(x, gates, reverse, scan_fn)
+        return _run_seq_scan(x, gates, reverse, scan_fn, ctx=ctx, name=name)
 
     specs = [s for s in (wspec, gspec, bspec) if s is not None]
     return make_node("lstmemory", forward, [input], name=name, size=size,
@@ -182,22 +207,23 @@ def grumemory(input, name=None, size=None, reverse=False, act=None,
             proj = proj + params[bspec.name]
         w = params[wspec.name]
 
-        def scan_fn(data, reset_bt, rev):
-            h_seq, _ = rnn_ops.gru_scan(
+        def scan_fn(data, reset_bt, rev, state):
+            h_seq, h_f = rnn_ops.gru_scan(
                 data,
                 x.mask(proj.dtype),
                 w_in=None,
                 b=None,
                 w_rec_rz=w[:, :2 * size],
                 w_rec_c=w[:, 2 * size:],
+                h0=None if state is None else state[0],
                 gate_act=g_act,
                 state_act=s_act,
                 reverse=rev,
                 reset_bt=reset_bt,
             )
-            return h_seq
+            return h_seq, [h_f]
 
-        return _run_seq_scan(x, proj, reverse, scan_fn)
+        return _run_seq_scan(x, proj, reverse, scan_fn, ctx=ctx, name=name)
 
     specs = [s for s in (wspec, bspec) if s is not None]
     return make_node("grumemory", forward, [input], name=name, size=size,
@@ -223,13 +249,14 @@ def recurrent(input, name=None, act=None, reverse=False, bias_attr=None,
         inp = x.data
         if bspec is not None:
             inp = inp + params[bspec.name]
-        def scan_fn(data, reset_bt, rev):
-            h_seq, _ = rnn_ops.rnn_scan(
-                data, x.mask(inp.dtype), params[wspec.name], act=act_fn,
+        def scan_fn(data, reset_bt, rev, state):
+            h_seq, h_f = rnn_ops.rnn_scan(
+                data, x.mask(inp.dtype), params[wspec.name],
+                h0=None if state is None else state[0], act=act_fn,
                 reverse=rev, reset_bt=reset_bt)
-            return h_seq
+            return h_seq, [h_f]
 
-        return _run_seq_scan(x, inp, reverse, scan_fn)
+        return _run_seq_scan(x, inp, reverse, scan_fn, ctx=ctx, name=name)
 
     specs = [s for s in (wspec, bspec) if s is not None]
     return make_node("recurrent", forward, [input], name=name, size=size,

@@ -57,6 +57,24 @@ def sequence_tagging_rnn(word_dict_size=5000, label_dict_size=67,
                 name="tag_scores")
 
 
+def sequence_tagging_gru(dict_size=1000, label_size=16, emb_size=32,
+                         hidden=64, name="gru_tag"):
+    """Forward-GRU tagger emitting per-timestep label probabilities —
+    the STREAMABLE serving shape (docs/serving.md "Continuous
+    batching"): every layer is per-position except the forward GRU,
+    whose carry the decode step threads across windows, so the topology
+    exports with ``decode_slots=`` and serves through the
+    continuous-batching scheduler (reference lineage: the
+    sequence_tagging demo's RNN half, minus the bidirectional/CRF parts
+    that read future timesteps and therefore cannot stream)."""
+    words = L.data(name="word",
+                   type=data_type.integer_value_sequence(dict_size))
+    emb = L.embedding(input=words, size=emb_size, name=name + "_emb")
+    rnn = networks.simple_gru(input=emb, size=hidden, name=name + "_gru")
+    return L.fc(input=rnn, size=label_size, act=A.Softmax(),
+                name=name + "_out")
+
+
 def ngram_lm(dict_size=2000, emb_size=32, hidden=64, gram_n=4):
     """N-gram neural LM (reference: v1_api_demo word embedding demo /
     imikolov usage)."""

@@ -49,6 +49,17 @@ Record types (field ``type``):
   ``rows`` (real rows), ``bucket`` (padded batch size), ``infer_ms``,
   optional ``batch``/``pad_rows``/``requests``/``queue_ms_max`` and the
   ``flush`` reason (``size``/``deadline``/``drain``).
+* ``serve_decode`` — one continuous-batching decode dispatch
+  (paddle_tpu.serve.scheduler): ``iteration``, ``active`` (occupied
+  slots), ``window`` (timesteps per dispatch), ``infer_ms``, optional
+  ``slots`` (capacity), ``steps`` (real masked-in slot-timesteps),
+  ``admitted``/``retired`` (sequences entering/leaving slots this
+  iteration) and ``model``.
+* ``serve_shed`` — one request rejected by serving admission control
+  (engine queue bound, scheduler queue bound, or the router's
+  priority-class shed policy): ``model``, ``reason``
+  (``queue_full``/``pressure``), optional ``priority`` and ``queued``
+  (queue state that triggered the shed).
 * ``anomaly`` — a sentinel trip (observe/sentinel.py): ``step``,
   ``kind`` (``nan_inf_loss``/``loss_divergence``), optional ``cost``
   (repr string when non-finite), ``threshold``, ``mode``, ``pass``.
@@ -392,6 +403,39 @@ class StepLog:
             rec["queue_ms_max"] = round(float(queue_ms_max), 4)
         if flush is not None:
             rec["flush"] = str(flush)
+        self.write(rec)
+
+    def log_serve_decode(self, iteration, active, window, infer_ms,
+                         slots=None, steps=None, admitted=None,
+                         retired=None, model=None):
+        """One continuous-batching decode dispatch
+        (paddle_tpu.serve.scheduler)."""
+        rec = {"type": "serve_decode", "iteration": int(iteration),
+               "active": int(active), "window": int(window),
+               "infer_ms": round(float(infer_ms), 4),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if slots is not None:
+            rec["slots"] = int(slots)
+        if steps is not None:
+            rec["steps"] = int(steps)
+        if admitted is not None:
+            rec["admitted"] = int(admitted)
+        if retired is not None:
+            rec["retired"] = int(retired)
+        if model is not None:
+            rec["model"] = str(model)
+        self.write(rec)
+
+    def log_serve_shed(self, model, reason, priority=None, queued=None):
+        """One request rejected by serving admission control
+        (paddle_tpu.serve.router / engine queue bounds)."""
+        rec = {"type": "serve_shed", "model": str(model),
+               "reason": str(reason),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if priority is not None:
+            rec["priority"] = str(priority)
+        if queued is not None:
+            rec["queued"] = int(queued)
         self.write(rec)
 
     def log_anomaly(self, step, kind, cost=None, threshold=None,

@@ -11,6 +11,13 @@ Three pillars (docs/serving.md):
 * :class:`InferenceEngine` (serve/engine.py) — thread-safe dynamic
   batching (flush on size / flush on deadline, bucket padding, warm
   per-bucket executable cache) with observe spans + steplog records.
+* :class:`ContinuousScheduler` (serve/scheduler.py) — iteration-level
+  ("continuous") batching for recurrent bundles exported with
+  ``decode_slots=``: admit/retire sequences between window dispatches
+  over a fixed slot matrix with reset-zeroed carry reuse.
+* :class:`Router` (serve/router.py) — multi-model hosting with
+  priority classes, bounded queues and :class:`Overloaded` load
+  shedding (the HTTP 429 path).
 
 ``paddle_tpu.cli export`` / ``cli serve`` wrap the three from the
 command line; ``paddle_tpu/capi`` loads bundles through the same
@@ -22,7 +29,9 @@ from :func:`load_bundle` stay free of the graph machinery —
 """
 
 from paddle_tpu.serve.bundle import Bundle, is_bundle, load_bundle
-from paddle_tpu.serve.engine import InferenceEngine
+from paddle_tpu.serve.engine import InferenceEngine, Overloaded
+from paddle_tpu.serve.router import Router
+from paddle_tpu.serve.scheduler import ContinuousScheduler
 
 
 def __getattr__(name):
@@ -34,5 +43,6 @@ def __getattr__(name):
                          % name)
 
 
-__all__ = ["Bundle", "InferenceEngine", "export_bundle", "is_bundle",
+__all__ = ["Bundle", "ContinuousScheduler", "InferenceEngine",
+           "Overloaded", "Router", "export_bundle", "is_bundle",
            "load_bundle", "verify_bundle"]

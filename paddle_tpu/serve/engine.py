@@ -40,6 +40,23 @@ from paddle_tpu.observe import steplog as observe_steplog
 from paddle_tpu.serve.bundle import flat_keys, pad_rows
 
 
+class Overloaded(RuntimeError):
+    """Admission control rejected a request BEFORE it entered a queue —
+    the fast 429 path (serve/server.py): under overload a bounded queue
+    plus immediate rejection keeps the latency of *accepted* requests
+    honest, where an unbounded queue would melt every p99 instead.
+    Raised by the engine/scheduler queue bounds and by the router's
+    priority-class shed policy (serve/router.py)."""
+
+    def __init__(self, message, model=None, priority=None, reason=None,
+                 queued=None):
+        super().__init__(message)
+        self.model = model
+        self.priority = priority
+        self.reason = reason or "queue_full"
+        self.queued = queued
+
+
 class _Request:
     __slots__ = ("inputs", "rows", "future", "t_enqueue", "req_id")
 
@@ -64,8 +81,17 @@ class InferenceEngine:
 
     def __init__(self, bundle, max_batch_size=None, max_latency_ms=5.0,
                  steplog=None, warmup=True, run_name="serve",
-                 metrics_registry=None):
+                 metrics_registry=None, model=None, max_queue_rows=None):
         self.bundle = bundle
+        # multi-model serving (serve/router.py): ``model`` labels every
+        # metric family of this engine with {model=...} so one registry
+        # tells N hosted bundles apart; ``max_queue_rows`` bounds the
+        # queue — submit() raises Overloaded instead of letting the
+        # backlog (and every accepted request's latency) grow unbounded
+        self.model = model
+        self.max_queue_rows = (None if max_queue_rows is None
+                               else int(max_queue_rows))
+        self._labels = {"model": str(model)} if model else {}
         self.max_batch_size = int(max_batch_size or bundle.max_batch())
         if self.max_batch_size > bundle.max_batch():
             raise ValueError(
@@ -145,45 +171,50 @@ class InferenceEngine:
         return self._worker.is_alive() and not self._stopped
 
     def _build_metrics(self):
-        m = self.metrics
+        m, lab = self.metrics, self._labels
         self._m_requests = m.counter(
             "paddle_tpu_serve_requests_total",
-            help="requests completed by the serving engine")
+            help="requests completed by the serving engine", labels=lab)
         self._m_rows = m.counter(
             "paddle_tpu_serve_rows_total",
-            help="real (unpadded) rows inferred")
+            help="real (unpadded) rows inferred", labels=lab)
         self._m_batches = m.counter(
             "paddle_tpu_serve_batches_total",
-            help="batches flushed to the device")
+            help="batches flushed to the device", labels=lab)
         self._m_batches_failed = m.counter(
             "paddle_tpu_serve_batches_failed_total",
-            help="batches whose forward raised")
+            help="batches whose forward raised", labels=lab)
         self._m_pad_rows = m.counter(
             "paddle_tpu_serve_pad_rows_total",
-            help="padding rows added to reach a bucket size")
+            help="padding rows added to reach a bucket size", labels=lab)
         self._m_flush = {
             reason: m.counter("paddle_tpu_serve_flush_total",
                               help="batch flushes by trigger",
-                              labels={"reason": reason})
+                              labels=dict(lab, reason=reason))
             for reason in ("size", "deadline", "drain")}
         self._m_queue_depth = m.gauge(
             "paddle_tpu_serve_queue_depth",
-            help="rows waiting for a batch flush")
+            help="rows waiting for a batch flush", labels=lab)
         self._m_in_flight = m.gauge(
             "paddle_tpu_serve_in_flight",
-            help="accepted requests not yet resolved")
+            help="accepted requests not yet resolved", labels=lab)
         self._m_ready = m.gauge(
             "paddle_tpu_serve_ready",
-            help="1 once every exported bucket is warm")
+            help="1 once every exported bucket is warm", labels=lab)
+        self._m_shed = m.counter(
+            "paddle_tpu_serve_shed_total",
+            help="requests rejected by admission control",
+            labels=dict(lab, reason="queue_full"))
         self._m_latency = m.histogram(
             "paddle_tpu_serve_request_latency_ms",
-            help="end-to-end request latency (enqueue to result)")
+            help="end-to-end request latency (enqueue to result)",
+            labels=lab)
         self._m_queue_ms = m.histogram(
             "paddle_tpu_serve_request_queue_ms",
-            help="time a request waited for its batch flush")
+            help="time a request waited for its batch flush", labels=lab)
         self._m_infer_ms = m.histogram(
             "paddle_tpu_serve_batch_infer_ms",
-            help="device forward time per flushed batch")
+            help="device forward time per flushed batch", labels=lab)
 
     # -- client surface -----------------------------------------------------
     def submit(self, inputs):
@@ -207,6 +238,17 @@ class InferenceEngine:
         with self._cv:
             if self._stopped:
                 raise RuntimeError("engine is stopped")
+            if (self.max_queue_rows is not None
+                    and self._queued_rows + rows > self.max_queue_rows):
+                self._stats["shed"] += 1
+                self._m_shed.inc()
+                raise Overloaded(
+                    "queue full: %d rows queued + %d requested > "
+                    "max_queue_rows=%d — shed, retry against a less "
+                    "loaded replica" % (self._queued_rows, rows,
+                                        self.max_queue_rows),
+                    model=self.model, reason="queue_full",
+                    queued=self._queued_rows)
             self._req_counter += 1
             req = _Request(inputs, rows, self._req_counter)
             self._queue.append(req)
@@ -220,6 +262,12 @@ class InferenceEngine:
     def infer(self, inputs, timeout=60.0):
         return self.submit(inputs).result(timeout=timeout)
 
+    def queue_depth(self):
+        """Rows currently waiting for a batch flush (the router's shed
+        policy reads this across all hosted models)."""
+        with self._cv:
+            return self._queued_rows
+
     def stats(self):
         """Engine counters plus live load state, snapshotted atomically
         under the engine lock: ``queue_depth`` (rows waiting for a batch
@@ -229,8 +277,10 @@ class InferenceEngine:
         with self._cv:
             out = dict(self._stats)
             for key in ("batches", "requests", "rows", "pad_rows",
-                        "flush_on_size", "flush_on_deadline"):
+                        "flush_on_size", "flush_on_deadline", "shed"):
                 out.setdefault(key, 0)
+            if self.model:
+                out["model"] = self.model
             out["queue_depth"] = self._queued_rows
             out["queued_rows"] = self._queued_rows  # back-compat alias
             out["in_flight"] = self._in_flight
@@ -368,7 +418,7 @@ class InferenceEngine:
         # cumulative per-bucket occupancy: fill + waste sum to 1.0 — the
         # capacity split between real rows and padding for this bucket
         slots = fill + waste
-        blabel = {"bucket": str(bucket["batch"])}
+        blabel = dict(self._labels, bucket=str(bucket["batch"]))
         self.metrics.gauge("paddle_tpu_serve_batch_fill_ratio",
                            help="real rows / bucket slots (cumulative)",
                            labels=blabel).set(fill / slots)

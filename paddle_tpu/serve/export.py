@@ -30,6 +30,7 @@ from paddle_tpu.utils.error import enforce
 
 DEFAULT_BATCH_SIZES = (1, 8, 32)
 DEFAULT_SEQ_LEN = 64
+DEFAULT_DECODE_WINDOW = 8
 
 
 class _InputSpec:
@@ -114,9 +115,80 @@ def _make_forward(topology, specs, out_names):
     return forward
 
 
+def _check_streamable(topology, specs):
+    """A topology can stream through the decode step only when nothing
+    mixes information ACROSS time positions except resettable recurrent
+    carries: the cross-position layer set is DERIVED from the layer
+    sources by the static analyzer (exactly the set that must refuse
+    packed input — streaming windows are the serving twin of packing),
+    and every input must be a sequence the scheduler can slice
+    window-by-window. Reverse recurrent layers additionally refuse at
+    trace time (layer/recurrent.py _run_seq_scan)."""
+    from paddle_tpu.analyze.topology_check import (
+        packed_rejecting_node_types)
+
+    blocked = packed_rejecting_node_types()
+    for node in topology.nodes:
+        enforce(
+            node.layer_type not in blocked,
+            "topology is not streamable: layer %r (type %s) mixes "
+            "values across time positions, so a decode window cannot "
+            "reproduce the full-sequence forward; continuous batching "
+            "needs a per-position head over resettable recurrent layers",
+            node.name, node.layer_type)
+    for spec in specs:
+        enforce(
+            spec.kind in ("seq_index", "seq_dense"),
+            "decode export needs every input to be a sequence slot "
+            "(got %r for input %r): non-sequence inputs have no "
+            "per-timestep slice to stream", spec.kind, spec.name)
+
+
+def _make_decode_step(topology, specs, out_names):
+    """The continuous-batching decode step that gets AOT-lowered once
+    per slot capacity: ``(params, carry, flat) -> (carry', outputs)``
+    over a fixed ``[slots, window]`` matrix.
+
+    ``flat`` carries one data window per sequence input plus two
+    shared control vectors: ``lens`` [S] i32 — valid steps this window
+    per slot (0 = idle slot, carry passes through under the mask) — and
+    ``reset`` [S] f32 — 1 where a freshly admitted sequence must not see
+    the retired occupant's carry (the serving twin of the ``reset_bt``
+    segment machinery; numeric safety first: the carry is zeroed BEFORE
+    the cells run). ``carry`` is ``{recurrent_layer_name: [leaf, ...]}``
+    with leading dim ``slots`` on every leaf."""
+    from paddle_tpu.core.sequence import SequenceBatch
+
+    def step(params, carry, flat):
+        reset = flat["reset"]
+        lens = flat["lens"]
+        keep = 1.0 - reset
+        carry = {
+            layer: [leaf * keep.reshape(
+                        (-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+                    for leaf in leaves]
+            for layer, leaves in carry.items()}
+        feed = {spec.name: SequenceBatch(flat[spec.name], lens)
+                for spec in specs}
+        values, state_out = topology.apply_decode(params, feed, carry)
+        outs = {}
+        for name in out_names:
+            val = values[name]
+            enforce(hasattr(val, "lengths"),
+                    "decode output %r is not a per-timestep sequence; "
+                    "continuous decode emits one output row per "
+                    "timestep (take the head's sequence output, not a "
+                    "pooled value)", name)
+            outs[name] = val.data
+        return state_out, outs
+
+    return step
+
+
 def export_bundle(output_layer, parameters, out_dir,
                   batch_sizes=DEFAULT_BATCH_SIZES, seq_len=None,
-                  name=None, platforms=None):
+                  name=None, platforms=None, decode_slots=None,
+                  decode_window=None):
     """AOT-export the inference forward over ``output_layer`` as a
     versioned bundle directory; returns the manifest dict.
 
@@ -126,6 +198,16 @@ def export_bundle(output_layer, parameters, out_dir,
     model has any; defaults to 64). ``platforms`` optionally lowers for
     several backends at once (e.g. ``("cpu", "tpu")``) so a bundle
     exported on a CPU host serves on the chip.
+
+    ``decode_slots`` additionally exports a **continuous-batching decode
+    step** per slot capacity (docs/serving.md "Continuous batching"):
+    one jitted ``[slots, window]`` window of the same forward with the
+    recurrent carries as explicit, DONATED arguments, so the serving
+    scheduler (serve/scheduler.py) can admit and retire sequences
+    between dispatches instead of padding every request to ``seq_len``.
+    Requires a streamable topology (per-position layers + forward
+    recurrent layers; checked). ``decode_window`` is the timesteps per
+    dispatch (default ``DEFAULT_DECODE_WINDOW`` = 8).
     """
     import jax
     from jax import export as jax_export
@@ -186,6 +268,69 @@ def export_bundle(output_layer, parameters, out_dir,
                  "shape_suffix": [int(d) for d in out_avals[n].shape[1:]]}
                 for n in out_names]
 
+    decode_manifest = None
+    if decode_slots:
+        _check_streamable(topology, specs)
+        window = int(decode_window or DEFAULT_DECODE_WINDOW)
+        enforce(window >= 1, "decode_window must be >= 1, got %r", window)
+        step = _make_decode_step(topology, specs, out_names)
+        slot_sizes = sorted({int(s) for s in decode_slots})
+        enforce(slot_sizes[0] >= 1,
+                "decode_slots must be positive, got %r", decode_slots)
+        carry_spec = None
+        decode_buckets = []
+        for slots in slot_sizes:
+            flat_structs = {
+                "lens": jax.ShapeDtypeStruct((slots,), np.int32),
+                "reset": jax.ShapeDtypeStruct((slots,), np.float32),
+            }
+            for spec in specs:
+                shape = ((slots, window) if spec.kind == "seq_index"
+                         else (slots, window, spec.dim))
+                flat_structs[spec.name] = jax.ShapeDtypeStruct(
+                    shape, np.dtype(spec.dtype))
+
+            def probe(params, flat, _specs=specs):
+                from paddle_tpu.core.sequence import SequenceBatch
+
+                feed = {s.name: SequenceBatch(flat[s.name], flat["lens"])
+                        for s in _specs}
+                _, st = topology.apply_decode(params, feed, {})
+                return st
+
+            state_structs = jax.eval_shape(probe, param_structs,
+                                           flat_structs)
+            enforce(bool(state_structs),
+                    "decode export found no recurrent carries — a "
+                    "carry-free topology has nothing to stream; serve "
+                    "it through the ordinary batch buckets")
+            # the carry is donated: slot state never round-trips the
+            # host and the scheduler's step is a true in-place update
+            jitted_step = jax.jit(step, donate_argnums=(1,))
+            try:
+                exported_step = jax_export.export(
+                    jitted_step, **export_kwargs)(
+                        param_structs, state_structs, flat_structs)
+            except Exception:
+                # donation support varies across jax.export versions;
+                # the step stays correct without it, only less frugal
+                exported_step = jax_export.export(
+                    jax.jit(step), **export_kwargs)(
+                        param_structs, state_structs, flat_structs)
+            artifact = "step_s%d.jaxexp" % slots
+            with open(os.path.join(out_dir, artifact), "wb") as fh:
+                fh.write(exported_step.serialize())
+            decode_buckets.append({"slots": slots, "artifact": artifact})
+            if carry_spec is None:
+                carry_spec = {
+                    layer: [{"shape_suffix": [int(d) for d in
+                                              leaf.shape[1:]],
+                             "dtype": str(np.dtype(leaf.dtype))}
+                            for leaf in leaves]
+                    for layer, leaves in state_structs.items()}
+        decode_manifest = {"window": window, "slots": decode_buckets,
+                           "carry": carry_spec}
+
     params_file = "params.npz"
     with open(os.path.join(out_dir, params_file), "wb") as fh:
         parameters.to_npz(fh)
@@ -210,6 +355,8 @@ def export_bundle(output_layer, parameters, out_dir,
         "buckets": buckets,
         "params_file": params_file,
     }
+    if decode_manifest is not None:
+        manifest["decode"] = decode_manifest
     with open(os.path.join(out_dir, MANIFEST_NAME), "w") as fh:
         json.dump(manifest, fh, indent=2)
     return manifest
@@ -244,4 +391,7 @@ def verify_bundle(out_dir):
     for name, arr in out.items():
         enforce(np.all(np.isfinite(arr)),
                 "bundle selfcheck: output %r is not finite", name)
+    if bundle.has_decoder():
+        # the decode artifacts must deserialize and run one window too
+        bundle.warmup_decoder()
     return {k: v.shape for k, v in out.items()}

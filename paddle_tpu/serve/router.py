@@ -1,0 +1,197 @@
+"""Multi-model routing with priority classes and load shedding
+(docs/serving.md "Multi-model routing & load shedding").
+
+One server process hosts N bundles, each behind its own engine (the
+whole-request batcher of serve/engine.py or the continuous-batching
+scheduler of serve/scheduler.py — the router is duck-typed over
+submit/infer/ready/live/queue_depth/stats/stop). Admission control is
+two-layered and runs BEFORE a request touches any queue:
+
+* **per-model bound** — each hosted model caps its own queue
+  (``max_queue_rows`` on the engine / ``max_queue`` on the scheduler);
+  a full queue sheds with reason ``queue_full`` regardless of priority.
+* **priority-class pressure** — every model carries a priority class
+  (``high`` > ``normal`` > ``low``). Each class owns a ceiling on the
+  TOTAL queued rows across ALL hosted models (``shed_capacity``); a
+  submission is shed with reason ``pressure`` when the global backlog
+  has already crossed its class ceiling. Low's ceiling is the smallest,
+  so under joint overload **low-priority traffic sheds first** and the
+  backlog the high-priority p99 sees stays bounded — the fleet contract
+  the mixed-run bench (benchmark/exp_serve.py --mode priority) and the
+  shed-order test (tests/test_scheduler.py) both pin.
+
+Every shed increments ``paddle_tpu_serve_shed_total{model=,priority=,
+reason=}`` and writes a ``serve_shed`` steplog record (schema v1), then
+raises :class:`~paddle_tpu.serve.engine.Overloaded` — the HTTP front
+end (serve/server.py) maps it to a fast 429 so clients can retry
+against another replica instead of camping in a melting queue.
+"""
+
+import threading
+
+from paddle_tpu.observe import metrics as observe_metrics
+from paddle_tpu.observe import steplog as observe_steplog
+from paddle_tpu.serve.engine import Overloaded
+
+# priority classes, strongest first; ``shed_capacity`` maps each to the
+# global queued-rows ceiling past which NEW submissions of that class
+# shed. None = never pressure-shed (per-model bounds still apply).
+PRIORITIES = ("high", "normal", "low")
+DEFAULT_SHED_CAPACITY = {"high": None, "normal": 1024, "low": 256}
+
+
+class HostedModel:
+    __slots__ = ("name", "bundle", "engine", "priority")
+
+    def __init__(self, name, bundle, engine, priority):
+        self.name = name
+        self.bundle = bundle
+        self.engine = engine
+        self.priority = priority
+
+
+class Router:
+    """Front door over N hosted models: per-model queues + priority
+    admission control + shed accounting. Use as a context manager or
+    call ``stop()`` (stops every hosted engine)."""
+
+    def __init__(self, metrics_registry=None, steplog=None,
+                 shed_capacity=None, run_name="serve"):
+        self.metrics = metrics_registry or observe_metrics.get_registry()
+        self.shed_capacity = dict(DEFAULT_SHED_CAPACITY)
+        if shed_capacity:
+            self.shed_capacity.update(shed_capacity)
+        self._lock = threading.Lock()
+        self._models = {}
+        self._owns_slog = steplog is None
+        self._slog = (observe_steplog.from_env(run_name=run_name,
+                                               meta={"phase": "serve"})
+                      if steplog is None else steplog)
+
+    def add_model(self, name, bundle, engine, priority="normal"):
+        """Host ``engine`` (an InferenceEngine or ContinuousScheduler
+        over ``bundle``) under ``name`` with a priority class."""
+        if priority not in PRIORITIES:
+            raise ValueError("unknown priority %r (choose from %s)"
+                             % (priority, list(PRIORITIES)))
+        with self._lock:
+            if name in self._models:
+                raise ValueError("model %r is already hosted" % name)
+            self._models[name] = HostedModel(name, bundle, engine,
+                                             priority)
+        return self._models[name]
+
+    def model(self, name):
+        try:
+            return self._models[name]
+        except KeyError:
+            raise KeyError(
+                "unknown model %r (hosted: %s)"
+                % (name, sorted(self._models))) from None
+
+    def models(self):
+        return dict(self._models)
+
+    def default_model(self):
+        """The single hosted model (single-model deployments route
+        ``POST /infer`` without a name); ambiguous with several."""
+        with self._lock:
+            if len(self._models) != 1:
+                raise KeyError(
+                    "%d models hosted — name one (POST /infer/<model>)"
+                    % len(self._models))
+            return next(iter(self._models.values()))
+
+    # -- admission ----------------------------------------------------------
+    def total_queued(self):
+        """Queued rows across every hosted model — the pressure signal
+        (the same number the per-model ``queue_depth`` gauges export)."""
+        return sum(m.engine.queue_depth() for m in self._models.values())
+
+    def _shed(self, hosted, reason, queued, count=True):
+        """Shed accounting. ``count=False`` when the hosted engine's own
+        queue bound already bumped its shed counter — the metric family
+        must count each rejection ONCE (the steplog record is always
+        the router's job; engines don't write serve_shed)."""
+        if count:
+            self.metrics.counter(
+                "paddle_tpu_serve_shed_total",
+                help="requests rejected by admission control",
+                labels={"model": hosted.name,
+                        "priority": hosted.priority,
+                        "reason": reason}).inc()
+        if self._slog is not None:
+            self._slog.log_serve_shed(model=hosted.name, reason=reason,
+                                      priority=hosted.priority,
+                                      queued=queued)
+
+    def submit(self, name, inputs):
+        """Route one request to model ``name``; returns the engine's
+        Future. Raises :class:`Overloaded` (fast, before any queue) when
+        admission control sheds it."""
+        hosted = self.model(name)
+        ceiling = self.shed_capacity.get(hosted.priority)
+        if ceiling is not None:
+            queued = self.total_queued()
+            if queued >= ceiling:
+                self._shed(hosted, "pressure", queued)
+                raise Overloaded(
+                    "global backlog %d >= %s-priority ceiling %d — "
+                    "shed" % (queued, hosted.priority, ceiling),
+                    model=hosted.name, priority=hosted.priority,
+                    reason="pressure", queued=queued)
+        try:
+            return hosted.engine.submit(inputs)
+        except Overloaded as exc:
+            exc.priority = hosted.priority
+            self._shed(hosted, exc.reason, exc.queued, count=False)
+            raise
+
+    def infer(self, name, inputs, timeout=60.0):
+        return self.submit(name, inputs).result(timeout=timeout)
+
+    # -- health -------------------------------------------------------------
+    def ready(self):
+        """True once EVERY hosted model's warmup completed — the
+        aggregate ``/readyz`` contract: a balancer must not route to a
+        process any of whose models would pay a compile."""
+        models = self._models
+        return bool(models) and all(m.engine.ready()
+                                    for m in models.values())
+
+    def ready_detail(self):
+        return {name: m.engine.ready()
+                for name, m in self._models.items()}
+
+    def live(self):
+        models = self._models
+        return bool(models) and all(m.engine.live()
+                                    for m in models.values())
+
+    def live_detail(self):
+        return {name: m.engine.live()
+                for name, m in self._models.items()}
+
+    def stats(self):
+        return {
+            "models": {name: m.engine.stats()
+                       for name, m in self._models.items()},
+            "priorities": {name: m.priority
+                           for name, m in self._models.items()},
+            "total_queued": self.total_queued(),
+            "shed_capacity": dict(self.shed_capacity),
+            "ready": self.ready(),
+        }
+
+    def stop(self, timeout=30.0):
+        for m in self._models.values():
+            m.engine.stop(timeout=timeout)
+        if self._owns_slog and self._slog is not None:
+            self._slog.close()
+            self._slog = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

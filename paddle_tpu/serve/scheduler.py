@@ -1,0 +1,483 @@
+"""Continuous-batching decode scheduler: iteration-level scheduling
+over a fixed-capacity slot matrix (docs/serving.md "Continuous
+batching").
+
+The whole-request engine (serve/engine.py) pads every sequence to the
+bundle's exported ``seq_len`` and a long decode holds its co-batched
+requests hostage for the full scan. This scheduler is the Orca-style
+fix (Yu et al., OSDI 2022, adapted to recurrent models): the bundle
+exports ONE jitted decode step over a ``[slots, window]`` matrix with
+the recurrent carries as explicit, donated arguments
+(``export_bundle(decode_slots=...)``), and the worker loop **admits and
+retires sequences between dispatches**:
+
+* every iteration runs ``window`` timesteps for every occupied slot
+  (idle slots ride the length mask, carry untouched);
+* a sequence that finishes frees its slot THAT iteration; the next
+  queued request is admitted into it with ``reset=1`` — the serving
+  twin of the ``reset_bt`` segment machinery, zeroing the carry BEFORE
+  the cells run so a reused slot can never leak the retired occupant's
+  state (numeric safety first: continuous output == per-request decode,
+  pinned by tests/test_scheduler.py);
+* slot capacity and window are the ONLY jit shapes — admission and
+  retirement change array *values*, never shapes, so the step stays a
+  single jit entry no matter how slots churn (``jit_entries`` pinned
+  via ``observe.steplog.watch_compiles`` in tier-1).
+
+Observability mirrors the engine: per-iteration ``serve_decode`` and
+per-request ``serve_request`` steplog records (schema v1), the
+``paddle_tpu_serve_*`` metric families labeled ``{model=...}`` plus
+decode-specific series (iterations, slot-steps, occupancy), and the
+k8s-style ready/live split with failed-warmup-stays-not-ready.
+"""
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from paddle_tpu.observe import metrics as observe_metrics
+from paddle_tpu.observe import spans as observe_spans
+from paddle_tpu.observe import steplog as observe_steplog
+from paddle_tpu.serve.bundle import SEQ_KINDS
+from paddle_tpu.serve.engine import Overloaded
+
+
+class _DecodeRequest:
+    __slots__ = ("data", "length", "future", "t_enqueue", "t_admit",
+                 "req_id", "collected")
+
+    def __init__(self, data, length, req_id):
+        self.data = data          # {input_name: [T, ...] array}
+        self.length = length
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.t_admit = None
+        self.req_id = req_id
+        self.collected = []       # [{out_name: [k, ...]}] per window
+
+
+class _Slot:
+    __slots__ = ("req", "pos")
+
+    def __init__(self):
+        self.req = None
+        self.pos = 0
+
+
+class ContinuousScheduler:
+    """Iteration-level ("continuous") batching front end of a decode-
+    capable :class:`Bundle`.
+
+    ``submit(inputs)`` takes ONE sequence per request — the same flat
+    wire format as the engine with a single row (``{name: [1, T] ids,
+    name+":lens": [1]}``; the lens key may be omitted when the data
+    array is exactly the sequence) — and returns a Future resolving to
+    ``{output_name: np.ndarray[T, ...]}`` with one output row per
+    timestep. Duck-type compatible with :class:`InferenceEngine`
+    (submit/infer/stats/ready/live/queue_depth/stop), so the router and
+    the HTTP front end host either interchangeably.
+    """
+
+    def __init__(self, bundle, slots=None, steplog=None, warmup=True,
+                 run_name="serve", metrics_registry=None, model=None,
+                 max_queue=256):
+        if not bundle.has_decoder():
+            raise ValueError(
+                "bundle %r has no decode artifacts; re-export with "
+                "decode_slots= for continuous batching" % bundle.name)
+        self.bundle = bundle
+        self.slots = int(bundle._decode_bucket(slots)["slots"])
+        self.window = int(bundle.decode_window)
+        self.model = model
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self._labels = {"model": str(model)} if model else {}
+        self._seq_specs = [s for s in bundle.inputs
+                           if s["kind"] in SEQ_KINDS]
+        self._out_names = [o["name"] for o in bundle.outputs]
+        self._cv = threading.Condition()
+        self._queue = collections.deque()
+        self._in_flight = 0
+        self._stopped = False
+        self._req_counter = 0
+        self._iter_counter = 0
+        self._stats = collections.Counter()
+        self._slots = [_Slot() for _ in range(self.slots)]
+        self._carry = None  # device-resident between iterations
+        self._owns_slog = steplog is None
+        self._slog = (observe_steplog.from_env(run_name=run_name,
+                                               meta={"phase": "serve"})
+                      if steplog is None else steplog)
+        self.metrics = metrics_registry or observe_metrics.get_registry()
+        self._build_metrics()
+        self._ready = threading.Event()
+        if warmup == "async":
+            def _bg_warmup():
+                try:
+                    self._warmup()
+                except Exception:  # noqa: BLE001 — logged in _warmup;
+                    pass           # the scheduler simply stays not-ready
+
+            threading.Thread(target=_bg_warmup,
+                             name="serve-decode-warmup",
+                             daemon=True).start()
+        elif warmup:
+            self._warmup()
+        else:
+            self._ready.set()
+            self._m_ready.set(1)
+        self._worker = threading.Thread(target=self._loop,
+                                        name="serve-decode-worker",
+                                        daemon=True)
+        self._worker.start()
+
+    # the decode step is ONE exported program per (slots, window) pair:
+    # after warmup, slot admission/retirement can never mint a shape
+    jit_entries = 1
+
+    def _warmup(self):
+        try:
+            with observe_spans.span("serve_decode_warmup",
+                                    args={"slots": self.slots,
+                                          "window": self.window}):
+                self.bundle.warmup_decoder(self.slots)
+        except Exception:
+            # failed warmup stays NOT-ready, exactly like the engine
+            # (PR 4): routing traffic here would pay the compile the
+            # probe exists to fence
+            from paddle_tpu.utils.logger import logger
+
+            logger.exception("decode warmup failed; scheduler stays "
+                             "not-ready")
+            raise
+        self._ready.set()
+        self._m_ready.set(1)
+
+    def ready(self):
+        return self._ready.is_set()
+
+    def live(self):
+        return self._worker.is_alive() and not self._stopped
+
+    def _build_metrics(self):
+        m, lab = self.metrics, self._labels
+        self._m_requests = m.counter(
+            "paddle_tpu_serve_requests_total",
+            help="requests completed by the serving engine", labels=lab)
+        self._m_rows = m.counter(
+            "paddle_tpu_serve_rows_total",
+            help="real (unpadded) rows inferred", labels=lab)
+        self._m_iters = m.counter(
+            "paddle_tpu_serve_decode_iterations_total",
+            help="continuous-batching decode dispatches", labels=lab)
+        self._m_slot_steps = m.counter(
+            "paddle_tpu_serve_decode_slot_steps_total",
+            help="real (masked-in) slot-timesteps decoded", labels=lab)
+        self._m_admitted = m.counter(
+            "paddle_tpu_serve_decode_admitted_total",
+            help="sequences admitted into a decode slot", labels=lab)
+        self._m_retired = m.counter(
+            "paddle_tpu_serve_decode_retired_total",
+            help="sequences retired from a decode slot", labels=lab)
+        self._m_shed = m.counter(
+            "paddle_tpu_serve_shed_total",
+            help="requests rejected by admission control",
+            labels=dict(lab, reason="queue_full"))
+        self._m_queue_depth = m.gauge(
+            "paddle_tpu_serve_queue_depth",
+            help="rows waiting for a batch flush", labels=lab)
+        self._m_in_flight = m.gauge(
+            "paddle_tpu_serve_in_flight",
+            help="accepted requests not yet resolved", labels=lab)
+        self._m_occupancy = m.gauge(
+            "paddle_tpu_serve_slot_occupancy",
+            help="occupied decode slots / capacity (last iteration)",
+            labels=lab)
+        self._m_ready = m.gauge(
+            "paddle_tpu_serve_ready",
+            help="1 once every exported bucket is warm", labels=lab)
+        self._m_latency = m.histogram(
+            "paddle_tpu_serve_request_latency_ms",
+            help="end-to-end request latency (enqueue to result)",
+            labels=lab)
+        self._m_queue_ms = m.histogram(
+            "paddle_tpu_serve_request_queue_ms",
+            help="time a request waited for its batch flush", labels=lab)
+        self._m_iter_ms = m.histogram(
+            "paddle_tpu_serve_decode_iter_ms",
+            help="device time per decode window dispatch", labels=lab)
+
+    # -- client surface -----------------------------------------------------
+    def submit(self, inputs):
+        """Enqueue ONE sequence; returns a Future of
+        {output_name: array[T, ...]} (one output row per timestep)."""
+        data, length = self._normalize(inputs)
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("scheduler is stopped")
+            if (self.max_queue is not None
+                    and len(self._queue) >= self.max_queue):
+                self._stats["shed"] += 1
+                self._m_shed.inc()
+                raise Overloaded(
+                    "decode queue full: %d requests queued >= "
+                    "max_queue=%d" % (len(self._queue), self.max_queue),
+                    model=self.model, reason="queue_full",
+                    queued=len(self._queue))
+            self._req_counter += 1
+            req = _DecodeRequest(data, length, self._req_counter)
+            self._queue.append(req)
+            self._in_flight += 1
+            self._m_queue_depth.set(len(self._queue))
+            self._m_in_flight.set(self._in_flight)
+            self._cv.notify_all()
+        return req.future
+
+    def infer(self, inputs, timeout=60.0):
+        return self.submit(inputs).result(timeout=timeout)
+
+    def queue_depth(self):
+        with self._cv:
+            return len(self._queue)
+
+    def _normalize(self, inputs):
+        """Wire format -> per-request {name: [T, ...]} + shared length.
+        Accepts [T]/[1, T] data arrays; an optional name+":lens" [1]
+        marks the valid prefix. All sequence inputs of one request
+        advance together, so their lengths must agree."""
+        data, length = {}, None
+        for spec in self._seq_specs:
+            name = spec["name"]
+            if name not in inputs:
+                raise KeyError(
+                    "request is missing sequence input %r (expected %s)"
+                    % (name, sorted(s["name"] for s in self._seq_specs)))
+            arr = np.asarray(inputs[name], dtype=np.dtype(spec["dtype"]))
+            want_ndim = 1 if spec["kind"] == "seq_index" else 2
+            if arr.ndim == want_ndim + 1:
+                if arr.shape[0] != 1:
+                    raise ValueError(
+                        "continuous decode takes ONE sequence per "
+                        "request; input %r has %d rows — submit them "
+                        "separately" % (name, arr.shape[0]))
+                arr = arr[0]
+            if arr.ndim != want_ndim:
+                raise ValueError(
+                    "input %r: expected a [T%s] sequence, got shape %s"
+                    % (name, "" if want_ndim == 1 else ", dim",
+                       arr.shape))
+            n = int(arr.shape[0])
+            lens_key = name + ":lens"
+            if lens_key in inputs:
+                lens = np.asarray(inputs[lens_key]).reshape(-1)
+                if lens.size != 1:
+                    raise ValueError(
+                        "input %r: one request, one length (got %d)"
+                        % (lens_key, lens.size))
+                n = int(lens[0])
+                if not 1 <= n <= arr.shape[0]:
+                    raise ValueError(
+                        "input %r: length %d outside [1, %d]"
+                        % (lens_key, n, arr.shape[0]))
+                arr = arr[:n]
+            if n < 1:
+                raise ValueError("input %r: empty sequence" % name)
+            if length is None:
+                length = n
+            elif length != n:
+                raise ValueError(
+                    "sequence inputs advance together through the "
+                    "decode slots: lengths differ (%d vs %d for %r)"
+                    % (length, n, name))
+            data[name] = arr
+        extra = (set(inputs) - {s["name"] for s in self._seq_specs}
+                 - {s["name"] + ":lens" for s in self._seq_specs})
+        if extra:
+            raise KeyError("unknown request inputs %s" % sorted(extra))
+        return data, length
+
+    def stats(self):
+        with self._cv:
+            out = dict(self._stats)
+            for key in ("requests", "rows", "iterations", "slot_steps",
+                        "admitted", "retired", "shed"):
+                out.setdefault(key, 0)
+            out["queue_depth"] = len(self._queue)
+            out["in_flight"] = self._in_flight
+            out["slots"] = self.slots
+            out["window"] = self.window
+        if self.model:
+            out["model"] = self.model
+        out["ready"] = self.ready()
+        out["latency_ms"] = self._m_latency.percentiles()
+        return out
+
+    def stop(self, timeout=30.0):
+        """Drain queued and in-slot sequences, stop the worker, close an
+        owned steplog. Idempotent."""
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._worker.join(timeout=timeout)
+        if self._owns_slog and self._slog is not None:
+            self._slog.close()
+            self._slog = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- worker -------------------------------------------------------------
+    def _wait_for_work(self):
+        """Block until a slot is occupied or a request is queued; returns
+        False when stopped AND fully drained."""
+        with self._cv:
+            while True:
+                busy = any(s.req is not None for s in self._slots)
+                if busy or self._queue:
+                    return True
+                if self._stopped:
+                    return False
+                self._cv.wait()
+
+    def _admit(self):
+        """Fill free slots from the queue; returns the admitted slot
+        indices (their carry must reset this iteration)."""
+        admitted = []
+        with self._cv:
+            for i, slot in enumerate(self._slots):
+                if slot.req is not None:
+                    continue
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+                req.t_admit = time.perf_counter()
+                slot.req = req
+                slot.pos = 0
+                admitted.append(i)
+            self._m_queue_depth.set(len(self._queue))
+        return admitted
+
+    def _loop(self):
+        while self._wait_for_work():
+            try:
+                self._run_iteration()
+            except Exception as exc:  # noqa: BLE001 — fail the occupants, not the engine
+                failed = []
+                with self._cv:
+                    for slot in self._slots:
+                        if slot.req is not None:
+                            failed.append(slot.req)
+                            slot.req = None
+                    self._in_flight -= len(failed)
+                    self._m_in_flight.set(self._in_flight)
+                    self._stats["iterations_failed"] += 1
+                self._carry = None  # poisoned by the failed dispatch
+                for req in failed:
+                    if not req.future.done():
+                        req.future.set_exception(exc)
+
+    def _run_iteration(self):
+        admitted = self._admit()
+        if self._carry is None:
+            self._carry = self.bundle.zero_carry(self.slots)
+        flat = self.bundle.dummy_decode_flat(self.slots, self.window)
+        reset = np.zeros((self.slots,), np.float32)
+        lens = np.zeros((self.slots,), np.int32)
+        for i in admitted:
+            reset[i] = 1.0
+        active = 0
+        for i, slot in enumerate(self._slots):
+            if slot.req is None:
+                continue
+            active += 1
+            k = min(slot.req.length - slot.pos, self.window)
+            lens[i] = k
+            for spec in self._seq_specs:
+                name = spec["name"]
+                flat[name][i, :k] = slot.req.data[name][
+                    slot.pos:slot.pos + k]
+        flat["lens"] = lens
+        flat["reset"] = reset
+        self._iter_counter += 1
+        # the step call AND the per-window output readback are the
+        # measured, sanctioned materialization point of the decode loop
+        # (the engine's serve_batch twin)
+        with observe_spans.span(
+                "serve_decode",
+                args={"active": active, "slots": self.slots,
+                      "window": self.window}) as scope:
+            self._carry, outs = self.bundle.decode_step(
+                self._carry, flat, self.slots)
+            outs = {k: np.asarray(v) for k, v in outs.items()}
+        infer_ms = scope.dur * 1e3
+        retired = self._distribute(outs, lens)
+        steps = int(lens.sum())
+        with self._cv:
+            self._stats["iterations"] += 1
+            self._stats["slot_steps"] += steps
+            self._stats["admitted"] += len(admitted)
+            self._stats["retired"] += len(retired)
+        self._m_iters.inc()
+        if steps:
+            self._m_slot_steps.inc(steps)
+        if admitted:
+            self._m_admitted.inc(len(admitted))
+        if retired:
+            self._m_retired.inc(len(retired))
+        self._m_iter_ms.observe(infer_ms)
+        self._m_occupancy.set(active / self.slots)
+        if self._slog is not None:
+            self._slog.log_serve_decode(
+                iteration=self._iter_counter, active=active,
+                window=self.window, slots=self.slots, steps=steps,
+                admitted=len(admitted), retired=len(retired),
+                infer_ms=infer_ms, model=self.model)
+
+    def _distribute(self, outs, lens):
+        """Hand each occupied slot its window of outputs; retire and
+        resolve sequences that finished. Returns the retired requests."""
+        retired = []
+        t_done = time.perf_counter()
+        for i, slot in enumerate(self._slots):
+            req, k = slot.req, int(lens[i])
+            if req is None or k == 0:
+                continue
+            # copies, not views: a slice of outs would pin the whole
+            # [slots, window, ...] iteration array until retirement —
+            # a slots-fold memory amplification per in-flight window
+            req.collected.append(
+                {name: outs[name][i, :k].copy()
+                 for name in self._out_names})
+            slot.pos += k
+            if slot.pos >= req.length:
+                slot.req = None
+                retired.append(req)
+        if not retired:
+            return retired
+        with self._cv:
+            self._in_flight -= len(retired)
+            self._m_in_flight.set(self._in_flight)
+            self._stats["requests"] += len(retired)
+            self._stats["rows"] += len(retired)
+        for req in retired:
+            result = {
+                name: np.concatenate([c[name] for c in req.collected],
+                                     axis=0)
+                for name in self._out_names}
+            queue_ms = (req.t_admit - req.t_enqueue) * 1e3
+            latency_ms = (t_done - req.t_enqueue) * 1e3
+            self._m_requests.inc()
+            self._m_rows.inc()
+            self._m_queue_ms.observe(queue_ms)
+            self._m_latency.observe(latency_ms)
+            if self._slog is not None:
+                self._slog.log_serve_request(
+                    rows=1, queue_ms=queue_ms, latency_ms=latency_ms,
+                    req_id=req.req_id)
+            req.future.set_result(result)
+        return retired

@@ -1,29 +1,32 @@
-"""Minimal HTTP front end for a serving engine (stdlib only).
+"""Minimal HTTP front end for the serving tier (stdlib only).
 
-``paddle_tpu.cli serve <bundle>`` wires a loaded bundle + batching
-engine behind three endpoints:
+Two deployment shapes over the same handler machinery:
 
-* ``POST /infer``   — body ``{"inputs": {flat_key: nested_lists}}``;
-  responds ``{"outputs": {name: nested_lists}}``. Dtypes come from the
-  bundle manifest, so clients send plain JSON numbers.
-* ``GET /healthz``  — ``{"ok": <ready>, "live": ..., "ready": ...,
-  "bundle": <name>}``. **Liveness** (the batcher thread is running) and
-  **readiness** (every exported bucket is warm — before that a request
-  pays a compile, so a balancer must not route here yet) are distinct:
-  status 200 when ready, 503 while live-but-warming. ``/livez`` and
-  ``/readyz`` expose each probe alone, k8s-style.
-* ``GET /metrics``  — Prometheus text exposition of the process-wide
-  registry (paddle_tpu.observe.metrics): request/row/batch counters,
-  queue-depth/in-flight gauges, latency histograms, per-bucket fill and
-  padding-waste ratios (docs/observability.md).
-* ``GET /stats``    — engine counters + live ``queue_depth``/
-  ``in_flight`` + exact latency percentiles, as JSON.
-* ``GET /manifest`` — the bundle manifest (model discovery, TF-Serving
-  GetModelMetadata analogue).
+* **Single model** (``paddle_tpu.cli serve <bundle>`` /
+  :func:`make_server`): ``POST /infer``, ``GET /healthz`` (liveness +
+  readiness in one, 503 while warming), ``/livez`` / ``/readyz``,
+  ``/metrics`` (Prometheus), ``/stats``, ``/manifest`` — unchanged
+  contract from PR 3/4.
+* **Multi-model** (:func:`make_router_server` over a
+  :class:`~paddle_tpu.serve.router.Router`): ``POST /infer/<model>``
+  routes through priority admission control — a shed request answers
+  **429** immediately (``{"error", "model", "priority", "reason"}``)
+  instead of queueing; ``GET /readyz`` is **per-model**: 503 until
+  EVERY hosted bundle's warmup completed, body
+  ``{"ready": bool, "models": {name: bool}}`` (a failed warmup keeps
+  its model not-ready forever, so the aggregate stays 503 — the PR 4
+  contract, now per model). ``/healthz`` aggregates live+ready with the
+  per-model detail, ``/manifest/<model>`` serves each manifest,
+  ``/stats`` is the router's fleet view.
+
+Engines are duck-typed: a hosted "engine" may be the whole-request
+batcher (serve/engine.py) or the continuous-batching scheduler
+(serve/scheduler.py).
 
 This is deliberately a thin demo/ops surface over the real subsystem
-(bundle + engine); production serving would put the PJRT-C-API path
-(docs/serving.md) or a proper RPC stack in front of the same engine.
+(bundle + engine + router); production serving would put the
+PJRT-C-API path (docs/serving.md) or a proper RPC stack in front of
+the same objects.
 """
 
 import json
@@ -33,6 +36,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from paddle_tpu.serve.bundle import SEQ_KINDS, flat_keys
+from paddle_tpu.serve.engine import Overloaded
 
 
 def _request_arrays(bundle, payload):
@@ -55,10 +59,7 @@ def _request_arrays(bundle, payload):
     return out
 
 
-class _Handler(BaseHTTPRequestHandler):
-    engine = None
-    bundle = None
-
+class _BaseHandler(BaseHTTPRequestHandler):
     def _send(self, code, obj):
         self._send_text(code, json.dumps(obj), "application/json")
 
@@ -70,10 +71,49 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_metrics(self, registry):
+        # Prometheus text exposition, format version 0.0.4
+        self._send_text(200, registry.to_prometheus(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+
     def log_message(self, fmt, *args):  # route through our logger, quietly
         from paddle_tpu.utils.logger import logger
 
         logger.debug("serve http: " + fmt, *args)
+
+    def _run_infer(self, bundle, infer_fn):
+        """Shared request body handling: parse, type the arrays against
+        ``bundle``'s manifest, run ``infer_fn(arrays, timeout_s)``,
+        answer JSON — the single-model and routed handlers differ only
+        in the callable."""
+        length = int(self.headers.get("Content-Length", "0"))
+        payload = json.loads(self.rfile.read(length) or b"{}")
+        arrays = _request_arrays(bundle, payload)
+        result = infer_fn(arrays, float(payload.get("timeout_s", 60.0)))
+        self._send(200, {"outputs": {k: np.asarray(v).tolist()
+                                     for k, v in result.items()}})
+
+    def _infer_errors(self, fn):
+        try:
+            fn()
+        except Overloaded as exc:
+            # the fast shed path: tell the client to back off / retry
+            # elsewhere BEFORE any queueing happened (429 Too Many
+            # Requests, the load-shed status)
+            self._send(429, {"error": str(exc), "model": exc.model,
+                             "priority": exc.priority,
+                             "reason": exc.reason})
+        except (ValueError, KeyError) as exc:
+            self._send(400, {"error": str(exc)})
+        except Exception as exc:  # noqa: BLE001 — surface, don't kill the server
+            self._send(500, {"error": str(exc)})
+
+
+class _Handler(_BaseHandler):
+    """Single-model handler (the PR 3/4 contract, unchanged)."""
+
+    engine = None
+    bundle = None
 
     def do_GET(self):
         if self.path == "/healthz":
@@ -88,10 +128,7 @@ class _Handler(BaseHTTPRequestHandler):
             ready = self.engine.ready()
             self._send(200 if ready else 503, {"ready": ready})
         elif self.path == "/metrics":
-            # Prometheus text exposition, format version 0.0.4
-            self._send_text(
-                200, self.engine.metrics.to_prometheus(),
-                "text/plain; version=0.0.4; charset=utf-8")
+            self._send_metrics(self.engine.metrics)
         elif self.path == "/stats":
             self._send(200, self.engine.stats())
         elif self.path == "/manifest":
@@ -103,32 +140,113 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/infer":
             self._send(404, {"error": "unknown path %s" % self.path})
             return
-        try:
-            length = int(self.headers.get("Content-Length", "0"))
-            payload = json.loads(self.rfile.read(length) or b"{}")
-            arrays = _request_arrays(self.bundle, payload)
-            result = self.engine.infer(
-                arrays, timeout=float(payload.get("timeout_s", 60.0)))
-            self._send(200, {"outputs": {k: np.asarray(v).tolist()
-                                         for k, v in result.items()}})
-        except (ValueError, KeyError) as exc:
-            self._send(400, {"error": str(exc)})
-        except Exception as exc:  # noqa: BLE001 — surface, don't kill the server
-            self._send(500, {"error": str(exc)})
+        self._infer_errors(
+            lambda: self._run_infer(self.bundle, self.engine.infer))
+
+
+class _RouterHandler(_BaseHandler):
+    """Multi-model handler over a Router."""
+
+    router = None
+
+    def do_GET(self):
+        router = self.router
+        if self.path == "/healthz":
+            live, ready = router.live(), router.ready()
+            live_d, ready_d = router.live_detail(), router.ready_detail()
+            self._send(200 if (live and ready) else 503,
+                       {"ok": live and ready, "live": live,
+                        "ready": ready,
+                        "models": {name: {"live": live_d[name],
+                                          "ready": ready_d[name]}
+                                   for name in sorted(live_d)}})
+        elif self.path == "/livez":
+            live = router.live()
+            self._send(200 if live else 503,
+                       {"live": live, "models": router.live_detail()})
+        elif self.path == "/readyz":
+            # per-model readiness: 503 until EVERY hosted bundle's
+            # warmup completed (a failed warmup keeps its model — and
+            # therefore the aggregate — not-ready)
+            ready = router.ready()
+            self._send(200 if ready else 503,
+                       {"ready": ready, "models": router.ready_detail()})
+        elif self.path == "/metrics":
+            self._send_metrics(router.metrics)
+        elif self.path == "/stats":
+            self._send(200, router.stats())
+        elif self.path == "/manifest":
+            try:
+                self._send(200, router.default_model().bundle.manifest)
+            except KeyError as exc:
+                self._send(400, {"error": str(exc)})
+        elif self.path.startswith("/manifest/"):
+            try:
+                name = self.path[len("/manifest/"):]
+                self._send(200, router.model(name).bundle.manifest)
+            except KeyError as exc:
+                self._send(404, {"error": str(exc)})
+        else:
+            self._send(404, {"error": "unknown path %s" % self.path})
+
+    def do_POST(self):
+        router = self.router
+        if self.path == "/infer":
+            def run():
+                hosted = router.default_model()
+                self._route(hosted)
+        elif self.path.startswith("/infer/"):
+            name = self.path[len("/infer/"):]
+
+            def run():
+                try:
+                    hosted = router.model(name)
+                except KeyError as exc:
+                    self._send(404, {"error": str(exc)})
+                    return
+                self._route(hosted)
+        else:
+            self._send(404, {"error": "unknown path %s" % self.path})
+            return
+        self._infer_errors(run)
+
+    def _route(self, hosted):
+        self._run_infer(
+            hosted.bundle,
+            lambda arrays, timeout: self.router.infer(
+                hosted.name, arrays, timeout=timeout))
 
 
 def make_server(bundle, engine, host="127.0.0.1", port=0):
-    """A ThreadingHTTPServer bound to (host, port); ``port=0`` picks a
+    """Single-model server bound to (host, port); ``port=0`` picks a
     free port (``server.server_address[1]`` is the actual one)."""
     handler = type("BundleHandler", (_Handler,),
                    {"engine": engine, "bundle": bundle})
     return ThreadingHTTPServer((host, port), handler)
 
 
+def make_router_server(router, host="127.0.0.1", port=0):
+    """Multi-model server over a :class:`~paddle_tpu.serve.router
+    .Router` (POST /infer/<model>, per-model /readyz, 429 shedding)."""
+    handler = type("RouterHandler", (_RouterHandler,),
+                   {"router": router})
+    return ThreadingHTTPServer((host, port), handler)
+
+
 def serve_in_thread(bundle, engine, host="127.0.0.1", port=0):
-    """Start the server on a daemon thread; returns (server, thread) —
-    tests and notebooks use this, the CLI uses serve_forever."""
-    server = make_server(bundle, engine, host, port)
+    """Start a single-model server on a daemon thread; returns
+    (server, thread) — tests and notebooks use this, the CLI uses
+    serve_forever."""
+    return _spawn(make_server(bundle, engine, host, port))
+
+
+def serve_router_in_thread(router, host="127.0.0.1", port=0):
+    """Start a multi-model router server on a daemon thread; returns
+    (server, thread)."""
+    return _spawn(make_router_server(router, host, port))
+
+
+def _spawn(server):
     thread = threading.Thread(target=server.serve_forever,
                               name="serve-http", daemon=True)
     thread.start()

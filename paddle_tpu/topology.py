@@ -201,6 +201,26 @@ class Topology:
                 raise
         return values
 
+    def apply_decode(self, params, feed, decode_state, outputs=None):
+        """Evaluate the DAG as ONE STREAMING WINDOW of a longer
+        sequence: recurrent layers boot from ``decode_state`` (a dict
+        ``{layer_name: [carry leaf, ...]}``; missing layers boot from
+        zeros as usual) and the final carries come back so the caller
+        can thread them into the next window. Test mode (serving).
+
+        Returns ``({layer_name: value}, {layer_name: [carry leaf, ...]})``
+        — the continuous-batching decode step (serve/export.py) is built
+        on this; reverse recurrent layers and cross-position layers
+        cannot stream and fail loudly (layer/recurrent.py,
+        serve/export.py streamability check)."""
+        ctx = Context(mode="test")
+        ctx.decode_state = decode_state if decode_state is not None else {}
+        ctx.decode_state_out = {}
+        values = self._run_nodes(params, feed, ctx)
+        wanted = outputs or [o.name for o in self.outputs]
+        return ({name: _external(values[name]) for name in wanted},
+                ctx.decode_state_out)
+
     def apply_all(self, params, feed, mode="test", rng=None):
         """Like apply() but returns every layer's value (debug / tests /
         --show_layer_stat parity)."""

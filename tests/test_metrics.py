@@ -89,6 +89,17 @@ def _golden_registry():
     c = reg.counter("paddle_tpu_serve_requests_total",
                     help="requests completed by the serving engine")
     c.inc(42)
+    # labeled families (multi-model serving, serve/router.py): the same
+    # family carries an unlabeled series AND {model=...} series, plus
+    # the shed counter's {model, priority, reason} label set
+    for model, n in (("mnist_mlp", 30), ("tagger", 12)):
+        reg.counter("paddle_tpu_serve_requests_total",
+                    help="requests completed by the serving engine",
+                    labels={"model": model}).inc(n)
+    reg.counter("paddle_tpu_serve_shed_total",
+                help="requests rejected by admission control",
+                labels={"model": "tagger", "priority": "low",
+                        "reason": "pressure"}).inc(7)
     g = reg.gauge("paddle_tpu_serve_queue_depth",
                   help="rows waiting for a batch flush")
     g.set(3)

@@ -1,0 +1,614 @@
+"""Continuous-batching serve tier tests (docs/serving.md).
+
+Covers the ISSUE 8 acceptance surface:
+
+* **segment isolation / numeric safety**: continuous-batched decode ==
+  per-request decode through the batch buckets (atol 1e-6; observed
+  bitwise on CPU), INCLUDING a slot retired and re-admitted mid-run —
+  a reused slot must never leak the previous occupant's carry.
+* **tier-1 scheduler smoke**: admit/retire/reuse over 3 synthetic
+  sequences on a 2-slot matrix.
+* **jit-entry pinning**: after warmup the decode step is ONE program —
+  slot admission/retirement churn mints zero compiles
+  (observe.steplog.watch_compiles).
+* **shed order**: on a CPU two-model router, low-priority submissions
+  shed (pressure, counted in metrics + ``serve_shed`` records) while
+  every high-priority request is accepted and completes.
+* **per-model readiness**: ``/readyz`` answers 503 until EVERY hosted
+  bundle's warmup completed; a failed warmup keeps its model (and the
+  aggregate) not-ready.
+* steplog records (``serve_decode``/``serve_shed``) stay schema-valid
+  against tests/golden/steplog_schema.json.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "steplog_schema.json")
+
+
+def _tagger_bundle(tmp, slots=(2,), window=4, seq_len=32, hidden=12):
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.text import sequence_tagging_gru
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve import load_bundle
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = sequence_tagging_gru(dict_size=50, label_size=5, emb_size=8,
+                               hidden=hidden)
+    params = Parameters.create(out)
+    bundle_dir = str(tmp / "tagger_bundle")
+    manifest = export_bundle(out, params, bundle_dir, batch_sizes=(1,),
+                             seq_len=seq_len, name="tagger",
+                             decode_slots=slots, decode_window=window)
+    return load_bundle(bundle_dir), manifest
+
+
+def _mlp_bundle(tmp, name="mnist_mlp"):
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.vision import mlp
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve import load_bundle
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = mlp(hidden=(16, 8))
+    params = Parameters.create(out)
+    bundle_dir = str(tmp / (name + "_bundle"))
+    export_bundle(out, params, bundle_dir, batch_sizes=(1, 4), name=name)
+    return load_bundle(bundle_dir)
+
+
+@pytest.fixture(scope="module")
+def decode_bundle(tmp_path_factory):
+    bundle, _ = _tagger_bundle(tmp_path_factory.mktemp("decode_bundle"))
+    return bundle
+
+
+def _sequences(lengths, vocab=50, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+def _per_request(bundle, seq):
+    """The whole-request baseline: pad to the exported seq_len, run the
+    batch bucket, slice the valid prefix."""
+    ids = np.zeros((1, bundle.seq_len), np.int32)
+    ids[0, :len(seq)] = seq
+    out = bundle.infer({"word": ids,
+                        "word:lens": np.array([len(seq)], np.int32)})
+    return out["gru_tag_out"][0, :len(seq)]
+
+
+# -- export / manifest -------------------------------------------------------
+
+def test_decode_manifest_and_artifacts(tmp_path):
+    bundle, manifest = _tagger_bundle(tmp_path, slots=(2, 4), window=8)
+    dec = manifest["decode"]
+    assert dec["window"] == 8
+    assert [b["slots"] for b in dec["slots"]] == [2, 4]
+    for b in dec["slots"]:
+        assert os.path.exists(os.path.join(bundle.directory,
+                                           b["artifact"]))
+    # ONE recurrent carry (the GRU), leading slot dim stripped
+    (layer, leaves), = dec["carry"].items()
+    assert leaves == [{"shape_suffix": [12], "dtype": "float32"}]
+    assert bundle.has_decoder() and bundle.decode_window == 8
+    assert bundle.decode_slot_sizes() == [2, 4]
+    carry = bundle.zero_carry(2)
+    assert carry[layer][0].shape == (2, 12)
+    with pytest.raises(ValueError, match="slot capacity"):
+        bundle.zero_carry(3)
+
+
+def test_decode_export_rejects_non_streamable():
+    """Cross-position topologies (pooling/conv heads) cannot stream —
+    the decode window could not reproduce the full-sequence forward."""
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.text import text_classification_cnn
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = text_classification_cnn(dict_size=20, emb_size=4, hidden=8)
+    params = Parameters.create(out)
+    with pytest.raises(Exception, match="not streamable"):
+        export_bundle(out, params, "/tmp/never_written_decode",
+                      batch_sizes=(1,), seq_len=8, decode_slots=(2,))
+
+
+def test_decode_export_rejects_reverse_recurrent():
+    """A reverse recurrent layer reads future timesteps — refused at
+    decode trace time (layer/recurrent.py)."""
+    from paddle_tpu import activation as A
+    from paddle_tpu import data_type as dt
+    from paddle_tpu import layer as L
+    from paddle_tpu import networks
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    words = L.data(name="word", type=dt.integer_value_sequence(20))
+    emb = L.embedding(input=words, size=4, name="rev_emb")
+    bwd = networks.simple_gru(input=emb, size=6, reverse=True,
+                              name="rev_gru")
+    out = L.fc(input=bwd, size=3, act=A.Softmax(), name="rev_out")
+    params = Parameters.create(out)
+    with pytest.raises(Exception, match="cannot stream"):
+        export_bundle(out, params, "/tmp/never_written_rev",
+                      batch_sizes=(1,), seq_len=8, decode_slots=(2,))
+
+
+# -- the acceptance equivalence: continuous == per-request -------------------
+
+def test_continuous_decode_equals_per_request(decode_bundle):
+    """Segment-isolation acceptance: 7 staggered sequences through 2
+    slots — every slot retires and re-admits at least once — and every
+    per-timestep output matches the per-request batch-bucket decode."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler
+
+    lengths = [7, 3, 10, 1, 5, 9, 2]
+    seqs = _sequences(lengths, seed=3)
+    with ContinuousScheduler(decode_bundle,
+                             metrics_registry=MetricsRegistry()) as sched:
+        futures = [sched.submit({"word": s}) for s in seqs]
+        results = [f.result(timeout=120) for f in futures]
+        stats = sched.stats()
+    for seq, got in zip(seqs, results):
+        want = _per_request(decode_bundle, seq)
+        assert got["gru_tag_out"].shape == want.shape
+        np.testing.assert_allclose(got["gru_tag_out"], want, atol=1e-6)
+    assert stats["requests"] == len(seqs)
+    assert stats["admitted"] == len(seqs)
+    assert stats["retired"] == len(seqs)
+    # 7 sequences through 2 slots: slots were necessarily reused
+    assert stats["admitted"] > stats["slots"]
+    # iteration-level scheduling actually packed work: the slot-step
+    # total is exactly the sum of real lengths (no seq_len padding)
+    assert stats["slot_steps"] == sum(lengths)
+
+
+def test_slot_reuse_does_not_leak_state(decode_bundle):
+    """The sharpest version of the reuse case: a LONG sequence pins one
+    slot while short sequences cycle through the other — each short
+    result must match its isolated per-request decode exactly (a carry
+    leak would poison the later occupants)."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler
+
+    long_seq = _sequences([25], seed=11)[0]
+    shorts = _sequences([2, 3, 2, 4, 3], seed=12)
+    with ContinuousScheduler(decode_bundle,
+                             metrics_registry=MetricsRegistry()) as sched:
+        f_long = sched.submit({"word": long_seq})
+        f_shorts = [sched.submit({"word": s}) for s in shorts]
+        got_long = f_long.result(timeout=120)["gru_tag_out"]
+        got_shorts = [f.result(timeout=120)["gru_tag_out"]
+                      for f in f_shorts]
+    np.testing.assert_allclose(got_long, _per_request(decode_bundle,
+                                                      long_seq),
+                               atol=1e-6)
+    for s, got in zip(shorts, got_shorts):
+        np.testing.assert_allclose(got, _per_request(decode_bundle, s),
+                                   atol=1e-6)
+
+
+# -- tier-1 smoke ------------------------------------------------------------
+
+def test_scheduler_smoke_admit_retire_reuse(decode_bundle):
+    """Fast tier-1 smoke: 3 synthetic sequences over 2 slots — admit,
+    retire, reuse — plus wire-format normalization and rejection."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler
+
+    with ContinuousScheduler(decode_bundle,
+                             metrics_registry=MetricsRegistry()) as sched:
+        # wire formats: bare [T], [1, T], and [1, T] + lens
+        f1 = sched.submit({"word": np.array([1, 2, 3], np.int32)})
+        f2 = sched.submit({"word": np.array([[4, 5]], np.int32)})
+        padded = np.zeros((1, 6), np.int32)
+        padded[0, :4] = [6, 7, 8, 9]
+        f3 = sched.submit({"word": padded,
+                           "word:lens": np.array([4], np.int32)})
+        shapes = [f.result(timeout=120)["gru_tag_out"].shape
+                  for f in (f1, f2, f3)]
+        assert shapes == [(3, 5), (2, 5), (4, 5)]
+        stats = sched.stats()
+        assert stats["retired"] == 3 and stats["in_flight"] == 0
+        with pytest.raises(ValueError, match="ONE sequence"):
+            sched.submit({"word": np.zeros((2, 3), np.int32)})
+        with pytest.raises(KeyError, match="missing sequence input"):
+            sched.submit({"wrong": np.array([1], np.int32)})
+        with pytest.raises(ValueError, match="empty"):
+            sched.submit({"word": np.zeros((0,), np.int32)})
+    with pytest.raises(RuntimeError, match="stopped"):
+        sched.submit({"word": np.array([1], np.int32)})
+
+
+def test_scheduler_jit_entries_pinned(decode_bundle):
+    """Slot capacity is a SINGLE jit entry: admission/retirement churn
+    after warmup mints zero compiles (the predict_jit_entries-style pin
+    for the serving scheduler)."""
+    from paddle_tpu.observe import steplog
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler
+
+    with ContinuousScheduler(decode_bundle,
+                             metrics_registry=MetricsRegistry()) as sched:
+        assert sched.jit_entries == 1
+        # warmup already ran (ctor); now churn admissions/retirements
+        # across very different lengths and watch the compile counter
+        with steplog.watch_compiles() as watcher:
+            futures = [sched.submit({"word": s})
+                       for s in _sequences([1, 6, 13, 2, 9, 4], seed=7)]
+            for f in futures:
+                f.result(timeout=120)
+        assert watcher.compiles == 0, watcher.events
+
+
+def test_serve_decode_steplog_records(decode_bundle, tmp_path):
+    """Every decode dispatch emits a schema-valid serve_decode record;
+    every completed sequence a serve_request record."""
+    from paddle_tpu.observe import steplog
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler
+
+    slog = steplog.StepLog(str(tmp_path), run_name="decode",
+                           compile_events=False)
+    with ContinuousScheduler(decode_bundle, steplog=slog,
+                             metrics_registry=MetricsRegistry(),
+                             model="tagger") as sched:
+        for f in [sched.submit({"word": s})
+                  for s in _sequences([5, 2, 8], seed=5)]:
+            f.result(timeout=120)
+        stats = sched.stats()
+    slog.close()
+    golden = json.load(open(GOLDEN))
+    records = steplog.read_jsonl(slog.path)
+    decodes = [r for r in records if r["type"] == "serve_decode"]
+    reqs = [r for r in records if r["type"] == "serve_request"]
+    assert len(decodes) == stats["iterations"] >= 1
+    assert len(reqs) == 3
+    for rec in decodes + reqs:
+        spec = golden["record_types"][rec["type"]]
+        keys = set(rec)
+        assert set(spec["required"]) <= keys, rec
+        assert not keys - set(spec["required"]) - set(spec["optional"]), rec
+    for rec in decodes:
+        assert rec["model"] == "tagger"
+        assert 0 <= rec["active"] <= stats["slots"]
+        assert rec["steps"] <= rec["active"] * rec["window"]
+    assert sum(r["steps"] for r in decodes) == stats["slot_steps"]
+    assert sum(r["admitted"] for r in decodes) == 3
+    assert sum(r["retired"] for r in decodes) == 3
+
+
+# -- admission control / shed order ------------------------------------------
+
+def test_engine_queue_bound_sheds(tmp_path):
+    """The engine-level bound: a full queue answers Overloaded at
+    submit time instead of queueing (the 429 path)."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine, Overloaded
+
+    bundle = _mlp_bundle(tmp_path)
+    gate = threading.Event()
+    real_run = bundle.run
+
+    def slow_run(flat, batch):
+        gate.wait(timeout=60)
+        return real_run(flat, batch)
+
+    bundle.run = slow_run
+    try:
+        reg = MetricsRegistry()
+        with InferenceEngine(bundle, max_batch_size=1,
+                             max_latency_ms=1.0, warmup=False,
+                             metrics_registry=reg, model="m1",
+                             max_queue_rows=2) as eng:
+            futures = []
+            shed = 0
+            for i in range(6):
+                x = {"pixel": np.zeros((1, 784), np.float32)}
+                try:
+                    futures.append(eng.submit(x))
+                except Overloaded as exc:
+                    shed += 1
+                    assert exc.reason == "queue_full"
+                    assert exc.model == "m1"
+            assert shed >= 2  # the bound held
+            gate.set()
+            for f in futures:
+                f.result(timeout=60)
+            assert eng.stats()["shed"] == shed
+        snap = reg.snapshot()["counters"]
+        assert snap['paddle_tpu_serve_shed_total'
+                    '{model="m1",reason="queue_full"}'] == shed
+    finally:
+        bundle.run = real_run
+
+
+def test_priority_shed_order_two_models(tmp_path):
+    """Acceptance: under joint overload the LOW-priority model sheds
+    (pressure, metrics + serve_shed records) while EVERY high-priority
+    request is admitted and completes."""
+    from paddle_tpu.observe import steplog
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine, Overloaded, Router
+
+    high_bundle = _mlp_bundle(tmp_path, name="high_mlp")
+    low_bundle = _mlp_bundle(tmp_path, name="low_mlp")
+    gate = threading.Event()
+    real_run = high_bundle.run
+
+    def gated_run(flat, batch):
+        gate.wait(timeout=120)
+        return real_run(flat, batch)
+
+    high_bundle.run = gated_run
+    reg = MetricsRegistry()
+    slog = steplog.StepLog(str(tmp_path), run_name="shed",
+                           compile_events=False)
+    try:
+        router = Router(metrics_registry=reg, steplog=slog,
+                        shed_capacity={"high": None, "low": 8})
+        router.add_model(
+            "high", high_bundle,
+            InferenceEngine(high_bundle, max_batch_size=4,
+                            max_latency_ms=1.0, warmup=False,
+                            metrics_registry=reg, model="high"),
+            priority="high")
+        router.add_model(
+            "low", low_bundle,
+            InferenceEngine(low_bundle, max_batch_size=4,
+                            max_latency_ms=1.0, warmup=False,
+                            metrics_registry=reg, model="low"),
+            priority="low")
+        with router:
+            x = {"pixel": np.zeros((1, 784), np.float32)}
+            # high floods while its device is gated: backlog builds PAST
+            # low's pressure ceiling, but high itself never sheds
+            high_futures = [router.submit("high", dict(x))
+                            for _ in range(24)]
+            assert router.total_queued() > 8
+            low_shed = 0
+            for _ in range(6):
+                try:
+                    router.submit("low", dict(x))
+                except Overloaded as exc:
+                    low_shed += 1
+                    assert exc.reason == "pressure"
+                    assert exc.priority == "low"
+            assert low_shed == 6  # every low submission shed...
+            gate.set()            # ...and every high request completes
+            for f in high_futures:
+                f.result(timeout=120)
+        snap = reg.snapshot()["counters"]
+        assert snap['paddle_tpu_serve_shed_total{model="low",'
+                    'priority="low",reason="pressure"}'] == low_shed
+        assert ('paddle_tpu_serve_shed_total{model="high",'
+                'priority="high",reason="pressure"}') not in snap
+    finally:
+        high_bundle.run = real_run
+        slog.close()
+    golden = json.load(open(GOLDEN))
+    sheds = [r for r in steplog.read_jsonl(slog.path)
+             if r["type"] == "serve_shed"]
+    assert len(sheds) == 6
+    for rec in sheds:
+        spec = golden["record_types"]["serve_shed"]
+        assert set(spec["required"]) <= set(rec), rec
+        assert rec["model"] == "low" and rec["priority"] == "low"
+        assert rec["reason"] == "pressure" and rec["queued"] > 8
+
+
+# -- per-model readiness -----------------------------------------------------
+
+def test_readyz_per_model_aggregation(tmp_path):
+    """/readyz is per-model: 503 with {models: {...}} until EVERY
+    hosted bundle's warmup completed; the failed-warmup-stays-not-ready
+    behavior holds per model."""
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine, Router
+    from paddle_tpu.serve.server import serve_router_in_thread
+
+    fast_bundle = _mlp_bundle(tmp_path, name="fast")
+    slow_bundle = _mlp_bundle(tmp_path, name="slow")
+    gate = threading.Event()
+    done = threading.Event()
+    real_warmup = slow_bundle.warmup
+
+    def gated_warmup():
+        gate.wait(timeout=60)
+        try:
+            return real_warmup()
+        finally:
+            done.set()
+
+    slow_bundle.warmup = gated_warmup
+    reg = MetricsRegistry()
+    try:
+        router = Router(metrics_registry=reg)
+        router.add_model("fast", fast_bundle,
+                         InferenceEngine(fast_bundle, warmup=True,
+                                         metrics_registry=reg,
+                                         model="fast"))
+        router.add_model("slow", slow_bundle,
+                         InferenceEngine(slow_bundle, warmup="async",
+                                         metrics_registry=reg,
+                                         model="slow"))
+        server, _ = serve_router_in_thread(router)
+        base = "http://%s:%d" % server.server_address
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(base + "/readyz", timeout=30)
+            assert exc_info.value.code == 503
+            payload = json.load(exc_info.value)
+            assert payload["ready"] is False
+            assert payload["models"] == {"fast": True, "slow": False}
+            assert not router.ready()
+
+            gate.set()
+            assert done.wait(timeout=60)
+            assert router.models()["slow"].engine._ready.wait(timeout=30)
+            got = json.load(urllib.request.urlopen(base + "/readyz",
+                                                   timeout=30))
+            assert got == {"ready": True,
+                           "models": {"fast": True, "slow": True}}
+            health = json.load(urllib.request.urlopen(base + "/healthz",
+                                                      timeout=30))
+            assert health["ok"] is True
+            assert health["models"]["slow"]["ready"] is True
+        finally:
+            server.shutdown()
+            router.stop()
+    finally:
+        slow_bundle.warmup = real_warmup
+
+
+def test_failed_warmup_keeps_model_not_ready(tmp_path):
+    """One model's broken warmup pins the AGGREGATE readiness at 503 —
+    the router must never advertise a process that would compile on
+    first traffic."""
+    import time
+
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine, Router
+
+    ok_bundle = _mlp_bundle(tmp_path, name="ok")
+    bad_bundle = _mlp_bundle(tmp_path, name="bad")
+    failed = threading.Event()
+
+    def broken_warmup():
+        try:
+            raise RuntimeError("corrupt artifact")
+        finally:
+            failed.set()
+
+    real_warmup = bad_bundle.warmup
+    bad_bundle.warmup = broken_warmup
+    reg = MetricsRegistry()
+    try:
+        router = Router(metrics_registry=reg)
+        router.add_model("ok", ok_bundle,
+                         InferenceEngine(ok_bundle, warmup=True,
+                                         metrics_registry=reg,
+                                         model="ok"))
+        router.add_model("bad", bad_bundle,
+                         InferenceEngine(bad_bundle, warmup="async",
+                                         metrics_registry=reg,
+                                         model="bad"))
+        with router:
+            assert failed.wait(timeout=30)
+            time.sleep(0.05)  # let the warmup thread unwind
+            assert router.ready_detail() == {"ok": True, "bad": False}
+            assert not router.ready()
+    finally:
+        bad_bundle.warmup = real_warmup
+
+
+def test_router_routes_and_rejects_unknown_model(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine, Router
+    from paddle_tpu.serve.server import serve_router_in_thread
+
+    bundle = _mlp_bundle(tmp_path)
+    reg = MetricsRegistry()
+    router = Router(metrics_registry=reg)
+    router.add_model("mlp", bundle,
+                     InferenceEngine(bundle, metrics_registry=reg,
+                                     model="mlp"))
+    with router:
+        server, _ = serve_router_in_thread(router)
+        base = "http://%s:%d" % server.server_address
+        try:
+            x = np.random.RandomState(0).randn(2, 784).astype(np.float32)
+            body = json.dumps({"inputs": {"pixel": x.tolist()}}).encode()
+            # named route and single-model default route agree
+            for path in ("/infer/mlp", "/infer"):
+                req = urllib.request.Request(
+                    base + path, data=body,
+                    headers={"Content-Type": "application/json"})
+                resp = json.load(urllib.request.urlopen(req, timeout=60))
+                got = np.asarray(resp["outputs"]["mlp_out"], np.float32)
+                want = bundle.infer({"pixel": x})["mlp_out"]
+                np.testing.assert_allclose(got, want, atol=1e-4)
+            req = urllib.request.Request(
+                base + "/infer/nope", data=body,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req, timeout=30)
+            assert exc_info.value.code == 404
+            manifest = json.load(urllib.request.urlopen(
+                base + "/manifest/mlp", timeout=30))
+            assert manifest["name"] == "mnist_mlp"
+            stats = json.load(urllib.request.urlopen(base + "/stats",
+                                                     timeout=30))
+            assert stats["models"]["mlp"]["requests"] >= 2
+            assert stats["priorities"] == {"mlp": "normal"}
+        finally:
+            server.shutdown()
+
+
+def test_engine_metrics_carry_model_label(tmp_path):
+    """Per-model {model=...} labels on the serve families (the
+    multi-model exposition contract the golden pins structurally)."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine
+
+    bundle = _mlp_bundle(tmp_path)
+    reg = MetricsRegistry()
+    with InferenceEngine(bundle, max_batch_size=4, max_latency_ms=2.0,
+                         metrics_registry=reg, model="mnist_mlp") as eng:
+        eng.infer({"pixel": np.zeros((2, 784), np.float32)}, timeout=60)
+    text = reg.to_prometheus()
+    assert 'paddle_tpu_serve_requests_total{model="mnist_mlp"} 1' in text
+    assert 'paddle_tpu_serve_rows_total{model="mnist_mlp"} 2' in text
+    assert ('paddle_tpu_serve_request_latency_ms_count'
+            '{model="mnist_mlp"} 1') in text
+
+
+# -- open-loop load (slow) ---------------------------------------------------
+
+@pytest.mark.slow
+def test_exp_serve_openloop_ab_gates(tmp_path, monkeypatch):
+    """The audited open-loop A/B harness end to end at a tiny scale:
+    fixed-seed arrival trace, gates asserted before rows emit, rows
+    sanitized + telemetry-mirrored."""
+    import benchmark.exp_serve as exp_serve
+
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY", str(tmp_path / "telem"))
+    rc = exp_serve.main([
+        "--mode", "openloop-ab", "--requests", "40",
+        "--arrival-qps", "200", "--seed", "7",
+        "--decode-slots", "4", "--decode-window", "4",
+        "--seq-len", "32", "--hidden", "24",
+        "--min-speedup", "0",  # tiny runs are noise; the slow gate run
+    ])                         # at real scale is the bench's job
+    assert rc == 0
+    import glob
+
+    logs = glob.glob(str(tmp_path / "telem" / "*.steps.jsonl"))
+    assert logs
+    from paddle_tpu.observe import steplog
+
+    rows = [r for p in logs for r in steplog.read_jsonl(p)
+            if r.get("type") == "bench_row"]
+    metrics_seen = {r["metric"] for r in rows}
+    assert any(m.startswith("serve_cont_") for m in metrics_seen)
+    assert any(m.startswith("serve_batch_") for m in metrics_seen)
