@@ -8,6 +8,13 @@ Three parts, one subsystem:
   unlocked module-level registries (PTA004). ``cli analyze --all`` runs
   them over the source tree and exits non-zero on findings — the CI
   one-liner next to ``cli observe --regress``.
+* :mod:`paddle_tpu.analyze.concurrency` — the interprocedural
+  concurrency/donation pass the statement-level checkers cannot see
+  across: per-class lock-guard inference (PTA005), the cross-module
+  lock acquisition graph with deadlock-cycle detection (PTA006), naked
+  ``Condition.wait()`` outside a predicate loop (PTA007), and
+  use-after-donate over ``jax.jit(donate_argnums=)``/AOT decode call
+  sites (PTA008). Runs through the same lint drivers and suppressions.
 * :mod:`paddle_tpu.analyze.topology_check` — pre-compile checks on a
   built topology, no tracing: packing legality (the cross-position
   layer set is DERIVED from the layer sources, not hand-listed), index
@@ -34,7 +41,9 @@ from paddle_tpu.analyze.lint import (  # noqa: F401
 )
 from paddle_tpu.analyze.topology_check import (  # noqa: F401
     check_topology,
+    estimate_hbm_bytes,
     format_report,
+    hbm_budget_bytes,
     predict_jit_entries,
     scan_layer_modules,
     verify_reject_packed_coverage,

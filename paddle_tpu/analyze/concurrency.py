@@ -1,0 +1,711 @@
+"""Interprocedural concurrency & donation-safety analysis (docs/analyze.md).
+
+PR 7's checkers (lint.py, PTA001-004) are statement-level: each looks at
+one call or one assignment. The hazards that actually bit the serving
+and fused-training tiers are *whole-program* properties — an attribute
+written under a lock in one method and read without it in another, a
+lock acquired in one module while holding a lock from a second, a carry
+donated to a jitted step and then read again. These checkers close that
+gap; they run over the full tree as part of ``cli analyze --all``:
+
+* **PTA005 unguarded-shared-state** — per class, infer which ``self._*``
+  attributes are guarded by which instance lock/Condition: an attribute
+  *mutated* inside ``with self.<lock>:`` (in any method outside
+  ``__init__``) is lock-protected, and every access of it — read or
+  write — from another method must hold one of its guarding locks.
+  One-level helper resolution: a private method whose every in-class
+  call site holds a class lock is analyzed as guarded (the same
+  resolution depth topology_check's layer derivation uses). Nested
+  function bodies (thread targets, callbacks) are analyzed as
+  UNGUARDED even when defined inside a lock block — they run later, on
+  whatever thread calls them.
+* **PTA006 lock-order-inversion** — build the cross-module lock
+  acquisition graph: nodes are instance locks (``Class.lock_attr``;
+  module-level locks are PTA004's domain and are not graphed), edges
+  mean "acquired while held". Direct nesting — including multi-item
+  ``with a, b:``, which acquires left to right — gives exact edges;
+  calls made while holding a lock resolve by method NAME across
+  every scanned class (the tree is duck-typed — engine→bundle,
+  router→engine, metrics-inside-everything — so name resolution is the
+  honest static approximation). Common container/primitive method names
+  (``get``/``set``/``inc``/``append``/...) are excluded from call
+  resolution: they collide with dict/deque methods on every lock-held
+  line and would wire the whole graph together. A cycle is a potential
+  deadlock.
+* **PTA007 naked-condition-wait** — ``Condition.wait()`` outside a
+  ``while`` loop. A woken waiter must re-test its predicate (spurious
+  wakeups, stolen wakeups); an ``if`` guard is the classic lost-wakeup
+  bug. Only receivers statically known to be Conditions are checked
+  (``self._cv = threading.Condition()``, module/local equivalents) —
+  ``subprocess.wait()``/``Event.wait()`` never flag.
+* **PTA008 use-after-donate** — for every callable bound via
+  ``jax.jit(..., donate_argnums=...)`` (and the AOT decode-step call
+  sites, which donate their carry at export), flag (a) reads of a
+  donated binding after the donating call on any path before a rebind,
+  (b) a donating call inside a loop that never rebinds the donated
+  binding (stale on the next iteration), and (c) the same binding
+  passed at two donated positions of one call (the replica-aliasing
+  class ``trainer._materialize_device_state`` dodges by hand).
+
+Suppression uses the same line-scoped ``# paddle-lint: disable=ID``
+comments as PTA001-004 (applied by the lint driver).
+"""
+
+import ast
+
+# lint.py imports this module only inside function bodies, so the
+# top-level import of its shared AST helper cannot cycle
+from paddle_tpu.analyze.lint import _call_name
+
+LOCK_CTORS = {"Lock", "RLock", "Condition"}
+MUTATORS = {"add", "append", "appendleft", "extend", "insert", "remove",
+            "discard", "pop", "popleft", "clear", "update", "setdefault"}
+
+# Methods whose accesses are construction-time (single-threaded by
+# definition) and never flagged by PTA005.
+CONSTRUCTION_METHODS = {"__init__", "__del__", "__new__"}
+
+# Method names NEVER used for cross-class call-edge resolution in the
+# lock graph: they collide with builtin container/instrument methods on
+# practically every lock-held line (self._queue.append, dict.get,
+# gauge.set, counter.inc ...) and would wire every lock to every other.
+UNRESOLVED_CALL_NAMES = {
+    "get", "set", "add", "pop", "update", "setdefault", "append",
+    "appendleft", "popleft", "remove", "discard", "clear", "extend",
+    "insert", "items", "keys", "values", "inc", "dec", "observe",
+    "reset", "state", "value", "copy", "join", "put", "split",
+    "format", "write", "read", "close", "open",
+}
+
+# Call names that jit-compile with donation when donate_argnums= is
+# passed at the binding site.
+JIT_NAMES = {"jit", "pjit"}
+
+# Method names whose call sites donate fixed argument positions by
+# contract (AOT-exported executables whose donation happened at export
+# time): Bundle.decode_step donates the carry it is passed first.
+DONATING_METHODS = {"decode_step": (0,)}
+
+
+def _dotted(node):
+    """'self._carry' / 'x' for Name/Attribute chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return base + "." + node.attr if base else None
+    return None
+
+
+def _finding(checker, path, line, message):
+    from paddle_tpu.analyze.lint import Finding
+
+    return Finding(checker, path, line, message)
+
+
+# -- per-class scan ----------------------------------------------------------
+
+class _Access:
+    __slots__ = ("attr", "mutate", "locks", "line")
+
+    def __init__(self, attr, mutate, locks, line):
+        self.attr = attr
+        self.mutate = mutate
+        self.locks = locks  # frozenset of class lock attrs held
+        self.line = line
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One pass over a method body tracking which class locks are held.
+
+    Collects attribute accesses (for PTA005), lock acquisitions and
+    lock-held calls (for PTA006), and condition waits (for PTA007).
+    Nested function/lambda bodies are scanned with an EMPTY lock stack:
+    a closure defined under a lock runs later, unguarded.
+    """
+
+    def __init__(self, cls):
+        self.cls = cls
+        self.held = []           # stack of frozensets of lock attrs
+        self.accesses = []       # [_Access]
+        self.acquisitions = []   # (lock_attr, held_before frozenset, line)
+        self.calls = []          # (name, is_self_call, held frozenset, line)
+        self.waits = []          # (cond_attr, in_while, line)
+        self.unlocked_self_calls = set()  # self.m() with no lock held
+        self._while_depth = 0
+
+    def _now_held(self):
+        out = set()
+        for layer in self.held:
+            out |= layer
+        return frozenset(out)
+
+    def _self_attr(self, node):
+        """X for a ``self.X`` Attribute node, else None."""
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    # -- locks ---------------------------------------------------------------
+    def visit_With(self, node):
+        # items acquire LEFT TO RIGHT (`with a, b:` == nested withs), so
+        # each item's acquisition records the earlier items as held —
+        # an AB/BA inversion written multi-item style is still a cycle
+        pushed = 0
+        for item in node.items:
+            attr = self._self_attr(item.context_expr)
+            self.visit(item.context_expr)
+            if attr in self.cls.lock_attrs:
+                self.acquisitions.append((attr, self._now_held(),
+                                          node.lineno))
+                self.held.append(frozenset({attr}))
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _visit_nested(self, node):
+        # thread targets / callbacks: defined here, run later, unguarded
+        saved, self.held = self.held, []
+        saved_while, self._while_depth = self._while_depth, 0
+        self.generic_visit(node)
+        self.held = saved
+        self._while_depth = saved_while
+
+    visit_FunctionDef = _visit_nested
+    visit_AsyncFunctionDef = _visit_nested
+    visit_Lambda = _visit_nested
+
+    def visit_While(self, node):
+        self._while_depth += 1
+        self.generic_visit(node)
+        self._while_depth -= 1
+
+    # -- accesses ------------------------------------------------------------
+    def _record(self, attr, mutate, line):
+        if attr is None or attr in self.cls.lock_attrs:
+            return
+        self.accesses.append(_Access(attr, mutate, self._now_held(), line))
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._record_target(t, node.lineno)
+        self.visit(node.value)
+
+    def _record_target(self, target, line):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_target(elt, line)
+        elif isinstance(target, ast.Starred):
+            self._record_target(target.value, line)
+        else:
+            attr = self._self_attr(target)
+            if attr is not None:
+                self._record(attr, True, line)
+            elif isinstance(target, ast.Subscript):
+                self._record(self._self_attr(target.value), True, line)
+                self.visit(target)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:  # bare annotations bind nothing
+            self._record_target(node.target, node.lineno)
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node):
+        attr = self._self_attr(node.target)
+        if attr is None and isinstance(node.target, ast.Subscript):
+            attr = self._self_attr(node.target.value)
+        self._record(attr, True, node.lineno)
+        self.visit(node.value)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                self._record(self._self_attr(t.value), True, t.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        func = node.func
+        name = _call_name(func)
+        recv_attr = None
+        if isinstance(func, ast.Attribute):
+            recv_attr = self._self_attr(func.value)
+        # condition waits (PTA007)
+        if name == "wait" and recv_attr in self.cls.cond_attrs:
+            self.waits.append((recv_attr, self._while_depth > 0,
+                               node.lineno))
+        # mutator calls on self attributes (self._queue.append(...))
+        if name in MUTATORS and recv_attr is not None:
+            self._record(recv_attr, True, node.lineno)
+        # calls made while holding a lock (PTA006 edges) + self-call
+        # sites for the guarded-helper resolution
+        held = self._now_held()
+        is_self_call = self._self_attr(func) is not None
+        if held and name is not None:
+            self.calls.append((name, is_self_call, held, node.lineno))
+        elif is_self_call and name is not None:
+            self.unlocked_self_calls.add(name)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self._record(self._self_attr(node), False, node.lineno)
+        self.generic_visit(node)
+
+
+class _ClassModel:
+    """Lock/access model of one class (PTA005/006/007 input)."""
+
+    def __init__(self, node, path):
+        self.name = node.name
+        self.path = path
+        self.lock_attrs = set()
+        self.cond_attrs = set()
+        self.rlock_attrs = set()
+        methods = [n for n in node.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for m in methods:
+            for sub in ast.walk(m):
+                if isinstance(sub, ast.Assign) \
+                        and isinstance(sub.value, ast.Call):
+                    ctor = _call_name(sub.value.func)
+                    if ctor in LOCK_CTORS:
+                        for t in sub.targets:
+                            if isinstance(t, ast.Attribute) \
+                                    and isinstance(t.value, ast.Name) \
+                                    and t.value.id == "self":
+                                self.lock_attrs.add(t.attr)
+                                if ctor == "Condition":
+                                    self.cond_attrs.add(t.attr)
+                                elif ctor == "RLock":
+                                    self.rlock_attrs.add(t.attr)
+        self.scans = {}
+        if self.lock_attrs:
+            for m in methods:
+                scan = _MethodScan(self)
+                for stmt in m.body:
+                    scan.visit(stmt)
+                self.scans[m.name] = scan
+
+
+def _collect_classes(tree, path):
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            model = _ClassModel(node, path)
+            if model.lock_attrs:
+                out.append(model)
+    return out
+
+
+# -- PTA005 ------------------------------------------------------------------
+
+def check_unguarded_state(classes, findings):
+    for cls in classes:
+        contexts = _call_contexts(cls)
+
+        def effective(mname, scan):
+            """(attr, mutate, effective lock set, line, via) triples —
+            a private helper's accesses are replicated once per in-class
+            call context (one-level helper resolution): called under the
+            lock, they are guarded; called without it, they are not."""
+            ctxs = contexts.get(mname) or {frozenset()}
+            for acc in scan.accesses:
+                for ctx in ctxs:
+                    yield acc.attr, acc.mutate, acc.locks | ctx, acc.line
+
+        # which locks guard which attrs: a mutation under a lock outside
+        # construction marks the attr as protected by those locks
+        protected = {}
+        for mname, scan in cls.scans.items():
+            if mname in CONSTRUCTION_METHODS:
+                continue
+            for attr, mutate, locks, _line in effective(mname, scan):
+                if mutate and locks:
+                    protected.setdefault(attr, set()).update(locks)
+        if not protected:
+            continue
+        for mname, scan in cls.scans.items():
+            if mname in CONSTRUCTION_METHODS:
+                continue
+            seen = set()
+            for attr, mutate, locks, line in effective(mname, scan):
+                guards = protected.get(attr)
+                if not guards or (locks & guards):
+                    continue
+                key = (attr, line)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(_finding(
+                    "PTA005", cls.path, line,
+                    "attribute 'self.%s' is guarded by %s elsewhere in "
+                    "%s but %s here without it (method %s)"
+                    % (attr,
+                       "/".join("self.%s" % g for g in sorted(guards)),
+                       cls.name,
+                       "written" if mutate else "read", mname)))
+
+
+def _call_contexts(cls):
+    """{private method name: set of lock-context frozensets} from its
+    in-class call sites. Construction-method call sites are skipped —
+    __init__ running a helper unlocked is single-threaded, not a leak.
+    Methods without recorded in-class call sites (public surface) get
+    the empty context."""
+    out = {}
+    for caller, scan in cls.scans.items():
+        if caller in CONSTRUCTION_METHODS:
+            continue
+        for name, is_self, held, _line in scan.calls:
+            if is_self and name in cls.scans and name.startswith("_"):
+                out.setdefault(name, set()).add(held)
+        for name in scan.unlocked_self_calls:
+            if name in cls.scans and name.startswith("_"):
+                out.setdefault(name, set()).add(frozenset())
+    return out
+
+
+# -- PTA006 ------------------------------------------------------------------
+
+def check_lock_graph(file_models, findings):
+    """Cross-module lock acquisition graph; cycles are PTA006.
+
+    ``file_models`` is ``[(path, classes)]`` as built by
+    :func:`collect_file_model`.
+    """
+    classes = [c for _path, cls_list in file_models for c in cls_list]
+    # locks acquired directly inside each method, by (class, method)
+    direct = {}
+    by_method_name = {}
+    for cls in classes:
+        for mname, scan in cls.scans.items():
+            locks = sorted({lock for lock, _held, _l in scan.acquisitions})
+            direct[(cls.name, mname)] = locks
+            if locks:
+                by_method_name.setdefault(mname, []).append((cls, locks))
+
+    edges = {}  # node -> {node: (path, line, why)}
+
+    def node_id(cls, lock):
+        return "%s.%s" % (cls.name, lock)
+
+    def add_edge(a, b, path, line, why):
+        tgt = edges.setdefault(a, {})
+        if b not in tgt:
+            tgt[b] = (path, line, why)
+
+    for cls in classes:
+        for mname, scan in cls.scans.items():
+            # direct nesting: acquire B while holding A (re-entering an
+            # RLock is legal, not a self-deadlock)
+            for lock, held, line in scan.acquisitions:
+                for h in held:
+                    if h == lock and lock in cls.rlock_attrs:
+                        continue
+                    add_edge(node_id(cls, h), node_id(cls, lock),
+                             cls.path, line, "nested with in %s()" % mname)
+            # calls while holding a lock
+            for name, is_self, held, line in scan.calls:
+                targets = []
+                if is_self and name in cls.scans:
+                    targets = [(cls, direct[(cls.name, name)])]
+                elif not is_self and name not in UNRESOLVED_CALL_NAMES:
+                    targets = by_method_name.get(name, [])
+                for target_cls, locks in targets:
+                    for lock in locks:
+                        for h in held:
+                            add_edge(node_id(cls, h),
+                                     node_id(target_cls, lock),
+                                     cls.path, line,
+                                     "%s.%s() called from %s.%s()"
+                                     % (target_cls.name, name,
+                                        cls.name, mname))
+
+    for cycle in _cycles(edges):
+        chain = " -> ".join(cycle + [cycle[0]])
+        path, line, why = edges[cycle[0]][cycle[1] if len(cycle) > 1
+                                          else cycle[0]]
+        findings.append(_finding(
+            "PTA006", path, line,
+            "lock acquisition cycle %s (%s): two threads taking these "
+            "locks in opposite orders deadlock" % (chain, why)))
+
+
+def _cycles(edges):
+    """Elementary cycles of a small digraph, one representative per
+    cycle set (rotation-normalized). DFS with a visited-stack."""
+    seen_cycles = set()
+    out = []
+
+    def dfs(start, node, stack, on_stack):
+        for nxt in sorted(edges.get(node, {})):
+            if nxt == start:
+                cycle = tuple(stack)
+                # normalize rotation so each cycle reports once
+                i = cycle.index(min(cycle))
+                norm = cycle[i:] + cycle[:i]
+                if norm not in seen_cycles:
+                    seen_cycles.add(norm)
+                    out.append(list(norm))
+            elif nxt not in on_stack and nxt > start:
+                # only explore nodes ordered after start: each cycle is
+                # found from its smallest node exactly once
+                stack.append(nxt)
+                on_stack.add(nxt)
+                dfs(start, nxt, stack, on_stack)
+                on_stack.discard(nxt)
+                stack.pop()
+
+    for start in sorted(edges):
+        dfs(start, start, [start], {start})
+    return out
+
+
+# -- PTA007 ------------------------------------------------------------------
+
+def check_naked_waits(tree, classes, path, findings):
+    # class-scoped: self.<cond>.wait() outside a while
+    for cls in classes:
+        for mname, scan in cls.scans.items():
+            for cond, in_while, line in scan.waits:
+                if not in_while:
+                    findings.append(_finding(
+                        "PTA007", path, line,
+                        "Condition 'self.%s'.wait() outside a while "
+                        "loop in %s.%s(): a woken waiter must re-test "
+                        "its predicate (spurious/stolen wakeups)"
+                        % (cond, cls.name, mname)))
+    # module/function-local conditions: name = threading.Condition()
+    local_conds = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _call_name(node.value.func) == "Condition":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local_conds.add(t.id)
+    if not local_conds:
+        return
+    _flag_local_waits(tree, local_conds, path, findings)
+
+
+def _flag_local_waits(tree, conds, path, findings):
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.while_depth = 0
+
+        def visit_While(self, node):
+            self.while_depth += 1
+            self.generic_visit(node)
+            self.while_depth -= 1
+
+        def visit_Call(self, node):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "wait" \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in conds and self.while_depth == 0:
+                findings.append(_finding(
+                    "PTA007", path, node.lineno,
+                    "Condition %r.wait() outside a while loop: a woken "
+                    "waiter must re-test its predicate"
+                    % func.value.id))
+            self.generic_visit(node)
+
+    V().visit(tree)
+
+
+# -- PTA008 ------------------------------------------------------------------
+
+def _donating_bindings(tree):
+    """{binding dotted-name: donated argnums tuple} for callables bound
+    via jax.jit/pjit(..., donate_argnums=.../donate_argnames=...).
+    Argnames resolve to positions through the jitted function's own def
+    when it lives in the same file; unresolvable names are dropped (the
+    binding still tracks any numeric positions)."""
+    defs = {n.name: n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef)}
+    out = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not (isinstance(call, ast.Call)
+                and _call_name(call.func) in JIT_NAMES):
+            continue
+        nums, names = [], []
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant):
+                        if isinstance(c.value, int):
+                            nums.append(int(c.value))
+                        elif isinstance(c.value, str):
+                            names.append(c.value)
+        if names and call.args and isinstance(call.args[0], ast.Name):
+            fn = defs.get(call.args[0].id)
+            if fn is not None:
+                a = fn.args
+                params = [p.arg for p in a.posonlyargs + a.args]
+                nums.extend(params.index(nm) for nm in names
+                            if nm in params)
+        if not nums:
+            continue
+        for t in node.targets:
+            name = _dotted(t)
+            if name:
+                out[name] = tuple(sorted(set(nums)))
+    return out
+
+
+def _bind_lines(func_node, name):
+    """Source lines where ``name`` is (re)bound inside ``func_node``."""
+    lines = []
+    for node in ast.walk(func_node):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            targets = [node.target]
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            for sub in ast.walk(t):
+                if _dotted(sub) == name and isinstance(
+                        getattr(sub, "ctx", ast.Store()), ast.Store):
+                    lines.append(node.lineno)
+    return sorted(lines)
+
+
+def check_use_after_donate(tree, path, findings):
+    donating = dict(_donating_bindings(tree))
+
+    # collect function parents for loop-ancestor lookup
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def loop_ancestor(node, top):
+        cur = parents.get(node)
+        while cur is not None and cur is not top:
+            if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    for func in ast.walk(tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = []
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            target = _dotted(node.func)
+            nums = donating.get(target) if target else None
+            if nums is None:
+                name = _call_name(node.func)
+                nums = DONATING_METHODS.get(name)
+            if nums:
+                calls.append((node, nums))
+        if not calls:
+            continue
+        for call, nums in calls:
+            donated = []
+            for pos in nums:
+                if pos < len(call.args):
+                    name = _dotted(call.args[pos])
+                    if name and name != "self":
+                        donated.append(name)
+            # (c) the same binding at two donated positions: the jit
+            # would donate one buffer twice (replica-aliasing class)
+            dupes = {n for n in donated if donated.count(n) > 1}
+            for name in sorted(dupes):
+                findings.append(_finding(
+                    "PTA008", path, call.lineno,
+                    "binding %r passed at two donated positions of the "
+                    "same call — one buffer donated twice" % name))
+            loop = loop_ancestor(call, func)
+            for name in dict.fromkeys(donated):
+                binds = _bind_lines(func, name)
+                # (b) donation inside a loop with no rebind in the loop:
+                # the next iteration reads a donated buffer
+                if loop is not None:
+                    lo, hi = loop.lineno, _max_line(loop)
+                    if not any(lo <= b <= hi for b in binds):
+                        findings.append(_finding(
+                            "PTA008", path, call.lineno,
+                            "%r is donated to %s inside a loop but "
+                            "never rebound in the loop body — the next "
+                            "iteration passes a donated (deleted) "
+                            "buffer" % (name,
+                                        _dotted(call.func)
+                                        or _call_name(call.func))))
+                        continue
+                # (a) reads after the donating call before any rebind
+                stmt = _enclosing_stmt(call, parents)
+                stmt_lines = set(range(stmt.lineno, _max_line(stmt) + 1)) \
+                    if stmt is not None else {call.lineno}
+                for read_line in _read_lines(func, name):
+                    if read_line in stmt_lines or read_line <= call.lineno:
+                        continue
+                    # a rebind on the donating call's own line is the
+                    # sanctioned idiom (x = step(x, ...)) and clears it
+                    if any(call.lineno <= b <= read_line for b in binds):
+                        continue
+                    findings.append(_finding(
+                        "PTA008", path, read_line,
+                        "%r read after being donated to %s at line %d "
+                        "— the buffer no longer exists (rebind it from "
+                        "the call's results or drop the read)"
+                        % (name, _dotted(call.func)
+                           or _call_name(call.func), call.lineno)))
+                    break  # one finding per donated binding per call
+
+
+def _enclosing_stmt(node, parents):
+    cur = node
+    while cur is not None:
+        parent = parents.get(cur)
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Module, ast.If, ast.For, ast.While,
+                               ast.With, ast.Try)):
+            return cur
+        cur = parent
+    return None
+
+
+def _max_line(node):
+    return max((getattr(n, "lineno", 0) for n in ast.walk(node)),
+               default=getattr(node, "lineno", 0))
+
+
+def _read_lines(func_node, name):
+    """Sorted lines where ``name`` is read (Load) inside ``func_node``."""
+    lines = set()
+    for node in ast.walk(func_node):
+        if isinstance(node, (ast.Name, ast.Attribute)) \
+                and isinstance(getattr(node, "ctx", None), ast.Load) \
+                and _dotted(node) == name:
+            lines.add(node.lineno)
+    return sorted(lines)
+
+
+# -- driver ------------------------------------------------------------------
+
+def collect_file_model(tree, path):
+    """(path, class models) — the unit the per-file checks and the
+    cross-module lock graph both consume."""
+    return (path, _collect_classes(tree, path))
+
+
+def check_file(tree, file_model, findings):
+    """PTA005 + PTA007 + PTA008 over one parsed file."""
+    path, classes = file_model
+    check_unguarded_state(classes, findings)
+    check_naked_waits(tree, classes, path, findings)
+    check_use_after_donate(tree, path, findings)

@@ -36,6 +36,13 @@ syntax:
   set); an unlocked mutation is a data race that only fires under
   serving load.
 
+PTA005-008 (unguarded shared state, lock-order inversion, naked
+condition waits, use-after-donate) are the interprocedural concurrency
+and donation checkers — see analyze/concurrency.py; they run through
+the same drivers, IDs and suppressions as PTA001-004. PTA006 builds its
+lock-acquisition graph across every linted file, so ``lint_paths``/
+``lint_tree`` see cross-module cycles a per-file lint cannot.
+
 Suppression: append ``# paddle-lint: disable=PTA001`` (comma-separate
 multiple IDs, or ``disable=all``) to the flagged line or the line just
 above it. Suppressions are deliberately line-scoped — a file-wide
@@ -69,6 +76,19 @@ CHECKERS = {
     "PTA004": ("unlocked-registry",
                "guard the mutation with the module's lock (add a "
                "module-level threading.Lock() if the module has none)"),
+    "PTA005": ("unguarded-shared-state",
+               "take the guarding lock around the access (or snapshot "
+               "the value under the lock and use the snapshot)"),
+    "PTA006": ("lock-order-inversion",
+               "acquire the locks in one global order everywhere, or "
+               "drop one of them (snapshot under the first lock, call "
+               "out after releasing it)"),
+    "PTA007": ("naked-condition-wait",
+               "wrap the wait in `while <predicate>:` — a woken waiter "
+               "must re-test its predicate (see engine._take_batch)"),
+    "PTA008": ("use-after-donate",
+               "rebind the name from the donating call's results "
+               "(x = step(x, ...)) or stop donating the argument"),
 }
 
 # Hot-path roots for PTA001, keyed by path suffix. Nested closures
@@ -82,6 +102,10 @@ HOT_PATHS = {
                            "_distribute", "_admit"},
     "serve/router.py": {"submit", "total_queued"},
     "data/feeder.py": {"_produce", "batches", "chunks"},
+    # per-step dispatch paths that predate PTA001: the cluster worker's
+    # whole train loop and the mesh strategy's per-step wrappers
+    "distributed/worker.py": {"main"},
+    "parallel/mesh.py": {"run", "shard_batch"},
 }
 
 # Calls whose results are device-resident values: reading them back with
@@ -118,6 +142,14 @@ class Finding:
     @property
     def title(self):
         return CHECKERS[self.checker][0]
+
+    def as_dict(self):
+        """Machine-readable shape of one finding — the ``cli analyze
+        --format=json`` record CI annotates PRs from. Key set and
+        ordering are a contract (tests/test_analyze.py)."""
+        return {"file": self.path, "line": self.line, "id": self.checker,
+                "title": self.title, "message": self.message,
+                "fixit": self.hint}
 
 
 def format_finding(f):
@@ -571,8 +603,11 @@ def _annotate_parents(tree):
             child._pl_parent = node
 
 
-def lint_source(source, path="<string>"):
-    """Lint one source string; returns unsuppressed [Finding]."""
+def _lint_file(source, path):
+    """Per-file checks (PTA001-005, 007, 008). Returns (kept findings,
+    concurrency file model for the cross-file lock graph, suppressions)."""
+    from paddle_tpu.analyze import concurrency
+
     tree = ast.parse(source, filename=path)
     _annotate_parents(tree)
     findings = []
@@ -581,17 +616,46 @@ def lint_source(source, path="<string>"):
     _check_jit_callsites(tree, path, findings)
     _check_threads(tree, path, findings)
     _check_registries(tree, path, findings)
+    model = concurrency.collect_file_model(tree, path)
+    concurrency.check_file(tree, model, findings)
     suppressions = _suppressions(source)
     kept = [f for f in findings if not _suppressed(f, suppressions)]
+    return kept, model, suppressions
+
+
+def lint_source(source, path="<string>"):
+    """Lint one source string; returns unsuppressed [Finding]. The
+    PTA006 lock graph covers only this file here — multi-file cycles
+    need :func:`lint_paths`/:func:`lint_tree`."""
+    from paddle_tpu.analyze import concurrency
+
+    kept, model, suppressions = _lint_file(source, path)
+    graph = []
+    concurrency.check_lock_graph([model], graph)
+    kept += [f for f in graph if not _suppressed(f, suppressions)]
     kept.sort(key=lambda f: (f.path, f.line, f.checker))
     return kept
 
 
 def lint_paths(paths):
+    """Lint several files, running the PTA006 lock-acquisition graph
+    over all of them at once (cross-module cycles)."""
+    from paddle_tpu.analyze import concurrency
+
     findings = []
+    models = []
+    suppressions_of = {}
     for path in paths:
         with open(path, encoding="utf-8") as fh:
-            findings.extend(lint_source(fh.read(), path))
+            kept, model, suppressions = _lint_file(fh.read(), path)
+        findings.extend(kept)
+        models.append(model)
+        suppressions_of[path] = suppressions
+    graph = []
+    concurrency.check_lock_graph(models, graph)
+    findings += [f for f in graph
+                 if not _suppressed(f, suppressions_of.get(f.path, {}))]
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
     return findings
 
 

@@ -16,6 +16,12 @@ Two gates, registered for the whole tier-1 run by tests/conftest.py:
   exceeds it. This pins shape-minting guarantees (bucket counts,
   steps_per_call K-invariance) that were previously asserted only by
   trajectory equality.
+
+Plus the ``tree_analysis`` session-scoped fixture: ONE full-tree run of
+``lint.lint_tree()`` (all eight checkers including the cross-module
+PTA006 lock graph) shared by every test that asserts on tree-wide
+findings — the concurrency pass over ~120 files runs once per suite,
+not once per test. Mark such tests ``@pytest.mark.analyze_tree``.
 """
 
 import threading
@@ -43,6 +49,10 @@ def pytest_configure(config):
         "markers",
         "allow_thread_leaks: opt a test out of the analyze thread-leak "
         "gate (justify in a comment)")
+    config.addinivalue_line(
+        "markers",
+        "analyze_tree: test consumes the suite-wide single-run full-tree "
+        "static analysis (session-scoped tree_analysis fixture)")
 
 
 @pytest.fixture(autouse=True)
@@ -65,6 +75,19 @@ def _thread_leak_gate(request):
             "reader/decorator._cancellable_put); see docs/analyze.md"
             % (len(leaked), sorted(t.name for t in leaked)),
             pytrace=False)
+
+
+@pytest.fixture(scope="session", name="tree_analysis")
+def _tree_analysis_fixture():
+    """ONE suite-wide static-analysis pass over the installed tree:
+    ``{"findings": [Finding], "files": N}``. Session-scoped so the
+    interprocedural concurrency checkers (PTA005-008 + the cross-module
+    lock graph) parse the ~120 files once, however many tests assert on
+    the result (docs/analyze.md)."""
+    from paddle_tpu.analyze import lint
+
+    findings, n_files = lint.lint_tree()
+    return {"findings": findings, "files": n_files}
 
 
 @pytest.fixture(name="max_retraces")

@@ -319,6 +319,171 @@ def check_topology(topology, parameters=None, steps_per_call=None):
     return report
 
 
+# -- static HBM footprint ----------------------------------------------------
+
+def hbm_budget_bytes(env=None):
+    """The operator-declared device-memory budget in bytes, from
+    ``PADDLE_TPU_HBM_BUDGET`` (plain bytes, or with a K/M/G[B] suffix,
+    e.g. ``16G``). None when unset/unparseable — the estimators then
+    report without warning."""
+    import os
+
+    raw = (env if env is not None
+           else os.environ.get("PADDLE_TPU_HBM_BUDGET", "")).strip()
+    if not raw:
+        return None
+    mult = 1
+    up = raw.upper().rstrip("B")
+    for suffix, m in (("K", 1024), ("M", 1024 ** 2), ("G", 1024 ** 3),
+                      ("T", 1024 ** 4)):
+        if up.endswith(suffix):
+            up = up[:-1]
+            mult = m
+            break
+    try:
+        return int(float(up) * mult)
+    except ValueError:
+        return None
+
+
+def _feed_bytes(topology, rows, seq_pad):
+    """Per-dispatch feed bytes from the topology's data layers: dense
+    [rows, dim] f32, index [rows] i32, sequence slots [rows, T(, dim)]
+    plus their [rows] i32 length vectors. Sub-threshold sparse slots
+    densify at the feed boundary (convert_feed), so they count dense;
+    at/above the threshold they feed as SparseRows padded id lists —
+    O(nnz), data-dependent — so they are skipped rather than counted as
+    a dense [rows, dim] that never exists on device."""
+    from paddle_tpu.data_type import (DENSE, INDEX, SEQ_NESTED, SEQ_NONE,
+                                      SEQ_SINGLE, SPARSE_BINARY,
+                                      SPARSE_FLOAT)
+    from paddle_tpu.utils import flags
+
+    sparse_threshold = flags.get_flag("sparse_feed_threshold")
+    total = 0
+    for name, itype in topology.data_types():
+        dim = int(itype.dim or 1)
+        if itype.seq_type == SEQ_NONE:
+            if itype.value_type == INDEX:
+                total += rows * 4
+            elif itype.value_type in (SPARSE_BINARY, SPARSE_FLOAT) \
+                    and dim >= sparse_threshold:
+                continue  # SparseRows id lists, not a [rows, dim] array
+            else:  # dense and densified sub-threshold sparse
+                total += rows * dim * 4
+        elif itype.seq_type in (SEQ_SINGLE, SEQ_NESTED):
+            pad = int((seq_pad or {}).get(name)
+                      or max((seq_pad or {}).values(), default=1) or 1)
+            per_pos = 4 if itype.value_type == INDEX else dim * 4
+            total += rows * pad * per_pos + rows * 4  # data + lengths
+    return total
+
+
+def _optimizer_slot_factor(optimizer):
+    """Bytes of optimizer slot state per parameter BYTE, probed from the
+    optimizer's own init_slot on a tiny parameter (param-shaped slots
+    scale with the parameter; scalar slots are noise)."""
+    import numpy as np
+
+    try:
+        import jax.numpy as jnp
+
+        probe = jnp.zeros((2, 3), jnp.float32)
+        leaves = [np.asarray(x) for x in optimizer.init_slot(probe)]
+    except Exception:
+        return 1.0  # momentum-class default
+    per_byte = 0.0
+    for leaf in leaves:
+        if leaf.shape == (2, 3):
+            per_byte += leaf.dtype.itemsize / 4.0
+    return per_byte
+
+
+def estimate_hbm_bytes(topology, rows=None, seq_pad=None, parameters=None,
+                       optimizer=None, mode="train", steps=1):
+    """Static HBM footprint of one compiled program, from the
+    topology's shape math alone — no tracing, no device.
+
+    Components (all bytes):
+
+    * ``params`` — every parameter buffer (trainable masters + static +
+      running state), exact when a :class:`Parameters` object is passed,
+      shape-derived (f32) otherwise;
+    * ``replica`` — the bf16 read replica of the trainable carry when a
+      sub-f32 compute dtype is active (mode="train" only);
+    * ``opt_slots`` — optimizer slot state, probed from the optimizer's
+      ``init_slot`` (Momentum 1x, Adam 2x the trainable bytes);
+    * ``feed`` — one dispatch's converted feed arrays for ``rows`` rows
+      at the ``seq_pad`` padded lengths, times ``steps`` for a fused
+      scan chunk (the stacked xs are device-resident for the dispatch);
+    * ``activations`` — rough forward working set: every non-data
+      layer's [rows, T, size] output in the compute dtype, doubled in
+      train mode for the backward's saved residuals. This is a peak
+      *working-set* term, deliberately coarse — the resident terms above
+      are the calibrated ones (tests pin them within 25% of live
+      ``nbytes``).
+
+    ``resident`` = params + replica + opt_slots + feed (the buffers that
+    exist across dispatches — what the donation carries hold); ``total``
+    adds the activation estimate. ``rows=None`` skips the per-dispatch
+    terms (parameter-side audit only, the trainer's pre-dispatch
+    budget check).
+    """
+    import numpy as np
+
+    if parameters is not None:
+        name_bytes = {n: int(np.asarray(parameters.get(n)).nbytes)
+                      for n in parameters.names()}
+        trainable_names, _static, _state = parameters.partition()
+        params_bytes = sum(name_bytes.values())
+        trainable_bytes = sum(name_bytes[n] for n in trainable_names)
+    else:
+        specs = topology.param_specs()
+        sizes = {n: int(np.prod(s.shape) or 1) * 4
+                 for n, s in specs.items()}
+        params_bytes = sum(sizes.values())
+        # trainable = not running state AND not frozen (is_static), the
+        # same split Parameters.partition() makes on the exact path
+        trainable_bytes = sum(
+            b for n, b in sizes.items()
+            if not specs[n].is_state
+            and not getattr(specs[n].attr, "is_static", False))
+
+    from paddle_tpu.core import dtype as dtype_mod
+    import jax.numpy as jnp
+
+    cd = dtype_mod.compute_dtype()
+    mixed = cd is not None and cd != jnp.float32
+    replica_bytes = trainable_bytes // 2 if (mode == "train" and mixed) \
+        else 0
+    opt_bytes = 0
+    if mode == "train" and optimizer is not None:
+        opt_bytes = int(trainable_bytes * _optimizer_slot_factor(optimizer))
+
+    feed_bytes = act_bytes = 0
+    if rows:
+        rows = int(rows)
+        feed_bytes = _feed_bytes(topology, rows, seq_pad) * max(int(steps
+                                                                    or 1), 1)
+        pad = max((seq_pad or {}).values(), default=1) or 1
+        elem = 2 if mixed else 4
+        act_elems = sum(rows * pad * int(node.size or 0)
+                        for node in topology.nodes
+                        if node.layer_type != "data")
+        act_bytes = act_elems * elem * (2 if mode == "train" else 1)
+
+    resident = params_bytes + replica_bytes + opt_bytes + feed_bytes
+    return {
+        "params": params_bytes,
+        "replica": replica_bytes,
+        "opt_slots": opt_bytes,
+        "feed": feed_bytes,
+        "activations": act_bytes,
+        "resident": resident,
+        "total": resident + act_bytes,
+    }
+
+
 # -- jit entry prediction ----------------------------------------------------
 
 def _chunk_plan(keys, k):
@@ -340,7 +505,8 @@ def _chunk_plan(keys, k):
 
 
 def predict_jit_entries(topology, reader, buckets=None, steps_per_call=None,
-                        feeding=None, drop_remainder=False):
+                        feeding=None, drop_remainder=False,
+                        parameters=None, optimizer=None):
     """The exact set of train programs a ``(topology, buckets,
     steps_per_call)`` combination will compile over ``reader``'s batch
     stream — computed by running the REAL bucketing regrouping and the
@@ -348,10 +514,13 @@ def predict_jit_entries(topology, reader, buckets=None, steps_per_call=None,
     tracing, no device).
 
     ``reader`` is the trainer's minibatch reader (zero-arg callable).
-    Returns ``{"entries": [...], "programs": N}`` where each entry is
-    ``{"kind": "step"|"scan", "rows": R, "seq_pad": {slot: T}, and for
-    scans "steps": K}`` — ``programs`` is the compile count the live
-    run must not exceed (pin it with ``analyze.max_retraces``).
+    Returns ``{"entries": [...], "programs": N, "hbm_peak_bytes": B}``
+    where each entry is ``{"kind": "step"|"scan", "rows": R,
+    "seq_pad": {slot: T}, "hbm": {...}, and for scans "steps": K}`` —
+    ``programs`` is the compile count the live run must not exceed (pin
+    it with ``analyze.max_retraces``), and ``hbm`` is each program's
+    static footprint estimate (:func:`estimate_hbm_bytes`; pass
+    ``parameters``/``optimizer`` for exact parameter/slot byte counts).
     """
     from paddle_tpu.core.sequence import bucket_length
     from paddle_tpu.data import bucketing
@@ -396,15 +565,30 @@ def predict_jit_entries(topology, reader, buckets=None, steps_per_call=None,
             entries.add(("step", key, 1))
 
     out = []
+    peak = 0
     for kind, (rows, pads), steps in sorted(entries):
         entry = {"kind": kind, "rows": rows, "seq_pad": dict(pads)}
         if kind == "scan":
             entry["steps"] = steps
+        entry["hbm"] = estimate_hbm_bytes(
+            topology, rows=rows, seq_pad=dict(pads),
+            parameters=parameters, optimizer=optimizer, mode="train",
+            steps=steps)
+        peak = max(peak, entry["hbm"]["total"])
         out.append(entry)
-    return {"entries": out, "programs": len(out)}
+    return {"entries": out, "programs": len(out), "hbm_peak_bytes": peak}
 
 
 # -- reporting / trainer hook ------------------------------------------------
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return ("%d%s" % (n, unit) if unit == "B"
+                    else "%.2f%s" % (n, unit))
+        n /= 1024.0
+    return "%d" % n
+
 
 def format_report(report):
     lines = []
@@ -426,6 +610,12 @@ def format_report(report):
                donation["state"], donation["replica"],
                " steps_per_call=%d" % donation["steps_per_call"]
                if "steps_per_call" in donation else ""))
+    hbm = report.get("hbm")
+    if hbm is not None:
+        lines.append(
+            "hbm estimate: params=%s opt_slots=%s replica=%s resident=%s"
+            % (_fmt_bytes(hbm["params"]), _fmt_bytes(hbm["opt_slots"]),
+               _fmt_bytes(hbm["replica"]), _fmt_bytes(hbm["resident"])))
     for w in report.get("warnings", ()):
         lines.append("warning: " + w)
     for e in report.get("errors", ()):
@@ -436,12 +626,26 @@ def format_report(report):
 def pretrain_check(trainer, steps_per_call=None):
     """The ``PADDLE_TPU_ANALYZE=1`` hook: run the static checks on a
     trainer's topology before the first dispatch. Warnings log;
-    errors raise (they mean runtime corruption, not style)."""
+    errors raise (they mean runtime corruption, not style). With a
+    ``PADDLE_TPU_HBM_BUDGET`` set, the parameter-side HBM footprint
+    (masters + replica + optimizer slots) is checked against it — the
+    OOM that would otherwise surface as a mid-compile allocation
+    failure warns here, before the first dispatch."""
     from paddle_tpu.utils.logger import logger
 
     report = check_topology(trainer.topology,
                             parameters=trainer.parameters,
                             steps_per_call=steps_per_call)
+    report["hbm"] = estimate_hbm_bytes(
+        trainer.topology, parameters=trainer.parameters,
+        optimizer=trainer.optimizer, mode="train")
+    budget = hbm_budget_bytes()
+    if budget is not None and report["hbm"]["resident"] > budget:
+        report["warnings"].append(
+            "static HBM estimate %s (params+replica+optimizer slots, "
+            "before feeds/activations) exceeds PADDLE_TPU_HBM_BUDGET=%s "
+            "— shard the model or lower the budgeted batch"
+            % (_fmt_bytes(report["hbm"]["resident"]), _fmt_bytes(budget)))
     for warning in report["warnings"]:
         logger.warning("analyze: %s", warning)
     if report["errors"]:

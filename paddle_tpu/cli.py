@@ -565,6 +565,10 @@ def cmd_analyze(args):
         report = topology_check.check_topology(
             topo, parameters=params,
             steps_per_call=args.steps_per_call or None)
+        optimizer = (cfg.optimizer()
+                     if hasattr(cfg, "optimizer") else None)
+        report["hbm"] = topology_check.estimate_hbm_bytes(
+            topo, parameters=params, optimizer=optimizer)
         buckets = ([int(b) for b in args.buckets.split(",") if b]
                    if args.buckets else None)
         if hasattr(cfg, "train_reader"):
@@ -578,20 +582,25 @@ def cmd_analyze(args):
                     base(), args.sample_batches)
             report["jit_entries"] = topology_check.predict_jit_entries(
                 topo, reader, buckets=buckets,
-                steps_per_call=args.steps_per_call or None)
-        if args.json:
+                steps_per_call=args.steps_per_call or None,
+                parameters=params, optimizer=optimizer)
+        if args.format == "json":
             print(json.dumps(report, indent=2))
         else:
             print(topology_check.format_report(report))
             if "jit_entries" in report:
                 je = report["jit_entries"]
-                print("jit entries: %d program(s)" % je["programs"])
+                print("jit entries: %d program(s), est. hbm peak %s"
+                      % (je["programs"],
+                         topology_check._fmt_bytes(je["hbm_peak_bytes"])))
                 for e in je["entries"]:
                     print("  %(kind)s rows=%(rows)d" % e
                           + (" steps=%d" % e["steps"]
                              if e["kind"] == "scan" else "")
                           + (" pad=%s" % e["seq_pad"]
-                             if e["seq_pad"] else ""))
+                             if e["seq_pad"] else "")
+                          + " hbm=%s" % topology_check._fmt_bytes(
+                              e["hbm"]["total"]))
         return 1 if report["errors"] else 0
 
     if args.paths:
@@ -601,10 +610,16 @@ def cmd_analyze(args):
         findings, n_files = lint.lint_tree()
     coverage = topology_check.verify_reject_packed_coverage()
     rc = 1 if (findings or coverage["missing"]) else 0
-    if args.json:
+    if args.format == "json":
+        # machine-readable findings (file/line/id/message/fixit, stable
+        # ordering) — the CI PR-annotation surface; exit code unchanged
+        # no sort_keys: each finding record keeps the documented
+        # file/line/id/title/message/fixit order; finding ORDER is
+        # already stabilized by the (file, line, id) sort in lint
         print(json.dumps({
             "files": n_files,
-            "findings": [f.__dict__ for f in findings],
+            "checkers": sorted(lint.CHECKERS),
+            "findings": [f.as_dict() for f in findings],
             "reject_packed": coverage}, indent=2))
         return rc
     for f in findings:
@@ -708,7 +723,12 @@ def main(argv=None):
     p.add_argument("--sample-batches", type=int, default=64,
                    help="how many reader batches the jit-entry "
                         "prediction simulates")
-    p.add_argument("--json", action="store_true")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="json = machine-readable findings (file/line/id/"
+                        "message/fixit, stable ordering) for CI PR "
+                        "annotation")
+    p.add_argument("--json", dest="format", action="store_const",
+                   const="json", help="alias for --format=json")
     p.set_defaults(fn=cmd_analyze)
 
     p = sub.add_parser("merge_model")

@@ -267,8 +267,8 @@ class StepLog:
             _open_logs.add(self)
 
     def _on_monitoring_event(self, event, secs):
-        if self._closed:
-            return
+        # no closed-check here: write() takes the lock and no-ops on a
+        # closed log, and an unlocked read of _closed would race close()
         try:
             self.write({"type": "event", "event": str(event),
                         "secs": round(float(secs), 6)})

@@ -335,6 +335,31 @@ def export_bundle(output_layer, parameters, out_dir,
     with open(os.path.join(out_dir, params_file), "wb") as fh:
         parameters.to_npz(fh)
 
+    # static HBM footprint of the largest exported program (params +
+    # largest-bucket feed + forward activations, docs/analyze.md): the
+    # number the sharded-bundle work sizes against, recorded in the
+    # manifest and checked against PADDLE_TPU_HBM_BUDGET at export time
+    # — a bundle that cannot fit its serving chip should fail the build,
+    # not the first /readyz probe
+    from paddle_tpu.analyze import topology_check as _topology_check
+
+    seq_pads = {s.name: seq_len for s in specs
+                if s.kind in ("seq_index", "seq_dense")}
+    hbm_est = _topology_check.estimate_hbm_bytes(
+        topology, rows=batch_sizes[-1], seq_pad=seq_pads,
+        parameters=parameters, mode="infer")
+    budget = _topology_check.hbm_budget_bytes()
+    if budget is not None and hbm_est["total"] > budget:
+        from paddle_tpu.utils.logger import logger
+
+        logger.warning(
+            "export_bundle: static HBM estimate %s for the largest "
+            "bucket (batch=%d) exceeds PADDLE_TPU_HBM_BUDGET=%s — the "
+            "bundle will not fit its serving chip; export smaller "
+            "buckets or wait for the sharded-bundle path",
+            _topology_check._fmt_bytes(hbm_est["total"]),
+            batch_sizes[-1], _topology_check._fmt_bytes(budget))
+
     from paddle_tpu.core import dtype as dtype_mod
 
     cd = dtype_mod.compute_dtype()
@@ -354,6 +379,7 @@ def export_bundle(output_layer, parameters, out_dir,
         "seq_len": seq_len,
         "buckets": buckets,
         "params_file": params_file,
+        "hbm_estimate_bytes": int(hbm_est["total"]),
     }
     if decode_manifest is not None:
         manifest["decode"] = decode_manifest

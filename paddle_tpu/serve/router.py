@@ -74,23 +74,31 @@ class Router:
         if priority not in PRIORITIES:
             raise ValueError("unknown priority %r (choose from %s)"
                              % (priority, list(PRIORITIES)))
+        hosted = HostedModel(name, bundle, engine, priority)
         with self._lock:
             if name in self._models:
                 raise ValueError("model %r is already hosted" % name)
-            self._models[name] = HostedModel(name, bundle, engine,
-                                             priority)
-        return self._models[name]
+            self._models[name] = hosted
+        return hosted
+
+    def _hosted(self):
+        """Point-in-time snapshot of the hosted-model table, taken under
+        the router lock — every reader goes through here so a concurrent
+        add_model can never race a dict iteration."""
+        with self._lock:
+            return dict(self._models)
 
     def model(self, name):
+        models = self._hosted()
         try:
-            return self._models[name]
+            return models[name]
         except KeyError:
             raise KeyError(
                 "unknown model %r (hosted: %s)"
-                % (name, sorted(self._models))) from None
+                % (name, sorted(models))) from None
 
     def models(self):
-        return dict(self._models)
+        return self._hosted()
 
     def default_model(self):
         """The single hosted model (single-model deployments route
@@ -106,7 +114,8 @@ class Router:
     def total_queued(self):
         """Queued rows across every hosted model — the pressure signal
         (the same number the per-model ``queue_depth`` gauges export)."""
-        return sum(m.engine.queue_depth() for m in self._models.values())
+        return sum(m.engine.queue_depth()
+                   for m in self._hosted().values())
 
     def _shed(self, hosted, reason, queued, count=True):
         """Shed accounting. ``count=False`` when the hosted engine's own
@@ -155,36 +164,37 @@ class Router:
         """True once EVERY hosted model's warmup completed — the
         aggregate ``/readyz`` contract: a balancer must not route to a
         process any of whose models would pay a compile."""
-        models = self._models
+        models = self._hosted()
         return bool(models) and all(m.engine.ready()
                                     for m in models.values())
 
     def ready_detail(self):
         return {name: m.engine.ready()
-                for name, m in self._models.items()}
+                for name, m in self._hosted().items()}
 
     def live(self):
-        models = self._models
+        models = self._hosted()
         return bool(models) and all(m.engine.live()
                                     for m in models.values())
 
     def live_detail(self):
         return {name: m.engine.live()
-                for name, m in self._models.items()}
+                for name, m in self._hosted().items()}
 
     def stats(self):
+        models = self._hosted()
         return {
             "models": {name: m.engine.stats()
-                       for name, m in self._models.items()},
+                       for name, m in models.items()},
             "priorities": {name: m.priority
-                           for name, m in self._models.items()},
+                           for name, m in models.items()},
             "total_queued": self.total_queued(),
             "shed_capacity": dict(self.shed_capacity),
             "ready": self.ready(),
         }
 
     def stop(self, timeout=30.0):
-        for m in self._models.values():
+        for m in self._hosted().values():
             m.engine.stop(timeout=timeout)
         if self._owns_slog and self._slog is not None:
             self._slog.close()

@@ -159,7 +159,9 @@ class ContinuousScheduler:
         return self._ready.is_set()
 
     def live(self):
-        return self._worker.is_alive() and not self._stopped
+        with self._cv:
+            stopped = self._stopped
+        return self._worker.is_alive() and not stopped
 
     def _build_metrics(self):
         m, lab = self.metrics, self._labels

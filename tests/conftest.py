@@ -46,6 +46,7 @@ import pytest
 from paddle_tpu.analyze.pytest_plugin import (  # noqa: F401
     _max_retraces_fixture,
     _thread_leak_gate,
+    _tree_analysis_fixture,
 )
 from paddle_tpu.analyze.pytest_plugin import (
     pytest_configure as _analyze_configure,

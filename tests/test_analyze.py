@@ -202,13 +202,496 @@ def test_suppression_comment():
     assert _ids(lint.lint_source(src3, "m.py")) == ["PTA003"]
 
 
-def test_checked_in_tree_lints_clean():
-    """THE satellite gate: the shipped source tree has zero findings —
-    real hazards are fixed, false positives carry inline suppressions."""
-    findings, n_files = lint.lint_tree()
+@pytest.mark.analyze_tree
+def test_checked_in_tree_lints_clean(tree_analysis):
+    """THE gate: the shipped source tree has zero findings across all
+    eight checkers (PTA001-008 incl. the cross-module lock graph) —
+    real hazards are fixed, false positives carry inline suppressions.
+    The session-scoped tree_analysis fixture runs the full-tree pass
+    ONCE suite-wide."""
+    findings, n_files = tree_analysis["findings"], tree_analysis["files"]
     assert n_files > 100
+    assert len(lint.CHECKERS) == 8
     assert findings == [], "\n".join(
         lint.format_finding(f) for f in findings)
+
+
+# ---- PTA005-008: interprocedural concurrency & donation checkers -----------
+
+_PTA005_SRC = """
+import threading
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._stopped = False
+    def submit(self, item):
+        with self._lock:
+            self._queue.append(item)
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+    def live(self):
+        return not self._stopped
+"""
+
+
+def test_pta005_unguarded_shared_state():
+    findings = lint.lint_source(_PTA005_SRC, "m.py")
+    assert _ids(findings) == ["PTA005"]
+    assert "'self._stopped'" in findings[0].message
+    assert "live" in findings[0].message
+    # the fixed form — read under the guarding lock — is clean
+    fixed = _PTA005_SRC.replace(
+        "        return not self._stopped",
+        "        with self._lock:\n            return not self._stopped")
+    assert lint.lint_source(fixed, "m.py") == []
+    # attributes never mutated under a lock are not lock-protected
+    # (single-writer worker state, e.g. the scheduler's slot matrix)
+    free = _PTA005_SRC.replace("            self._stopped = True",
+                               "            pass")
+    assert lint.lint_source(free, "m.py") == []
+
+
+def test_pta005_helper_resolution_and_init_exempt():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"          # construction: unguarded OK
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._apply()\n"
+        "    def _apply(self):\n"
+        "        self._n += 1\n"         # runs under bump()'s lock
+    )
+    assert lint.lint_source(src, "m.py") == []
+    # the same helper ALSO called without the lock loses the exemption
+    src_bad = src + ("    def bump_unlocked(self):\n"
+                     "        self._apply()\n")
+    findings = lint.lint_source(src_bad, "m.py")
+    assert _ids(findings) == ["PTA005"]
+
+
+_PTA006_SRC = """
+import threading
+class A:
+    def __init__(self):
+        self._la = threading.Lock()
+        self.peer = None
+    def foo(self):
+        with self._la:
+            self.peer.bar_step()
+    def foo_step(self):
+        with self._la:
+            pass
+class B:
+    def __init__(self):
+        self._lb = threading.Lock()
+        self.peer = None
+    def bar(self):
+        with self._lb:
+            self.peer.foo_step()
+    def bar_step(self):
+        with self._lb:
+            pass
+"""
+
+
+def test_pta006_lock_order_inversion():
+    findings = lint.lint_source(_PTA006_SRC, "m.py")
+    assert _ids(findings) == ["PTA006"]
+    assert "A._la" in findings[0].message
+    assert "B._lb" in findings[0].message
+    # break the inversion (B no longer calls back into A under its
+    # lock): the AB edge alone is a legal order, not a cycle
+    fixed = _PTA006_SRC.replace("            self.peer.foo_step()",
+                                "            pass")
+    assert lint.lint_source(fixed, "m.py") == []
+
+
+def test_pta006_cross_module_cycle(tmp_path):
+    """The graph is built across FILES: each module alone is clean, the
+    pair deadlocks (the engine→bundle / router→engine shape)."""
+    a = tmp_path / "mod_a.py"
+    b = tmp_path / "mod_b.py"
+    head, tail = _PTA006_SRC.split("class B:")
+    a.write_text(head)
+    b.write_text("import threading\nclass B:" + tail)
+    assert lint.lint_source(a.read_text(), str(a)) == []
+    assert lint.lint_source(b.read_text(), str(b)) == []
+    findings = lint.lint_paths([str(a), str(b)])
+    assert _ids(findings) == ["PTA006"]
+
+
+_PTA007_SRC = """
+import threading
+class W:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._queue = []
+    def take(self):
+        with self._cv:
+            if not self._queue:
+                self._cv.wait()
+            return self._queue.pop()
+    def put(self, x):
+        with self._cv:
+            self._queue.append(x)
+            self._cv.notify_all()
+"""
+
+
+def test_pta007_naked_condition_wait():
+    findings = lint.lint_source(_PTA007_SRC, "m.py")
+    assert _ids(findings) == ["PTA007"]
+    assert "while" in findings[0].message
+    # the predicate-loop form is the sanctioned idiom
+    fixed = _PTA007_SRC.replace("if not self._queue:",
+                                "while not self._queue:")
+    assert lint.lint_source(fixed, "m.py") == []
+    # only Conditions are checked: Event.wait()/subprocess wait() never
+    # need a predicate loop
+    src_event = (
+        "import threading\n"
+        "def go(proc):\n"
+        "    ev = threading.Event()\n"
+        "    ev.wait()\n"
+        "    proc.wait()\n"
+    )
+    assert lint.lint_source(src_event, "m.py") == []
+
+
+_PTA008_SRC = """
+import jax
+def f(x, y):
+    return x + y
+step = jax.jit(f, donate_argnums=(0,))
+def run(x, y):
+    out = step(x, y)
+    return x + out
+"""
+
+
+def test_pta008_read_after_donate():
+    findings = lint.lint_source(_PTA008_SRC, "m.py")
+    assert _ids(findings) == ["PTA008"]
+    assert "'x' read after being donated" in findings[0].message
+    # the rebind idiom is the fix
+    fixed = _PTA008_SRC.replace("    out = step(x, y)\n    return x + out",
+                                "    x = step(x, y)\n    return x")
+    assert lint.lint_source(fixed, "m.py") == []
+
+
+def test_pta008_loop_and_alias_forms():
+    src = (
+        "import jax\n"
+        "def f(c, x):\n"
+        "    return c\n"
+        "step = jax.jit(f, donate_argnums=(0,))\n"
+        "pair = jax.jit(f, donate_argnums=(0, 1))\n"
+        "def run_loop(carry, feeds):\n"
+        "    for f_ in feeds:\n"
+        "        out = step(carry, f_)\n"   # stale on iteration 2
+        "    return out\n"
+        "def run_alias(x):\n"
+        "    return pair(x, x)\n"           # one buffer donated twice
+    )
+    findings = lint.lint_source(src, "m.py")
+    assert _ids(findings) == ["PTA008", "PTA008"]
+    messages = " | ".join(f.message for f in findings)
+    assert "never rebound in the loop" in messages
+    assert "two donated positions" in messages
+    # carry rebound per iteration is the sanctioned scan-feed idiom;
+    # two DISTINCT live bindings fix the double-donation
+    fixed = src.replace("        out = step(carry, f_)",
+                        "        carry = step(carry, f_)") \
+               .replace("    return out", "    return carry") \
+               .replace("def run_alias(x):", "def run_alias(x, y):") \
+               .replace("    return pair(x, x)", "    return pair(x, y)")
+    assert lint.lint_source(fixed, "m.py") == []
+
+
+def test_pta008_decode_step_callsite():
+    """AOT decode-step call sites donate their carry by contract."""
+    src = (
+        "def iterate(bundle, carry, flat):\n"
+        "    c2, outs = bundle.decode_step(carry, flat)\n"
+        "    return carry, outs\n"
+    )
+    findings = lint.lint_source(src, "m.py")
+    assert _ids(findings) == ["PTA008"]
+    fixed = src.replace("c2, outs", "carry, outs")
+    assert lint.lint_source(fixed, "m.py") == []
+
+
+def test_new_ids_suppressible():
+    src = _PTA005_SRC.replace(
+        "        return not self._stopped",
+        "        return not self._stopped  # paddle-lint: disable=PTA005")
+    assert lint.lint_source(src, "m.py") == []
+
+
+def test_finding_as_dict_json_shape():
+    """The --format=json record: file/line/id/message/fixit with stable
+    key order, findings pre-sorted by (file, line, id)."""
+    src = (
+        "import threading\n"
+        "def go(fn):\n"
+        "    threading.Thread(target=fn)\n"
+        "    threading.Thread(target=fn)\n"
+    )
+    findings = lint.lint_source(src, "m.py")
+    assert [f.line for f in findings] == [3, 4]
+    d = findings[0].as_dict()
+    assert list(d) == ["file", "line", "id", "title", "message", "fixit"]
+    assert d["id"] == "PTA003" and d["file"] == "m.py" and d["fixit"]
+
+
+def test_cli_analyze_format_json(tmp_path, capsys):
+    import json as json_mod
+
+    from paddle_tpu import cli
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import threading\n"
+                   "def go(fn):\n"
+                   "    threading.Thread(target=fn)\n")
+    rc = cli.main(["analyze", str(bad), "--format=json"])
+    assert rc == 1
+    out = json_mod.loads(capsys.readouterr().out)
+    assert out["checkers"] == sorted(lint.CHECKERS)
+    assert [f["id"] for f in out["findings"]] == ["PTA003"]
+    # key ORDER is the documented contract, not just the key set
+    assert list(out["findings"][0]) == ["file", "line", "id", "title",
+                                        "message", "fixit"]
+
+
+def test_hot_paths_cover_worker_and_mesh():
+    """Satellite: the per-step dispatch paths that predate PTA001 are
+    registered hot, and a seeded sync in them is caught."""
+    assert "distributed/worker.py" in lint.HOT_PATHS
+    assert "parallel/mesh.py" in lint.HOT_PATHS
+    src = (
+        "def main():\n"
+        "    out = _train_step(x)\n"
+        "    return float(out)\n"
+    )
+    assert _ids(lint.lint_source(src, "distributed/worker.py")) == [
+        "PTA001"]
+    src_mesh = (
+        "def run(feed):\n"
+        "    out = _train_step(feed)\n"
+        "    return out.item()\n"
+    )
+    assert _ids(lint.lint_source(src_mesh, "parallel/mesh.py")) == [
+        "PTA001"]
+
+
+# ---- regression tests for the hazards the new checkers surfaced ------------
+
+class _FakeEngine:
+    """Duck-typed engine for Router-only tests (no device, no bundle)."""
+
+    def __init__(self):
+        self.stopped = False
+
+    def queue_depth(self):
+        return 2
+
+    def ready(self):
+        return True
+
+    def live(self):
+        return not self.stopped
+
+    def stats(self):
+        return {"queue_depth": 2}
+
+    def stop(self, timeout=30.0):
+        self.stopped = True
+
+
+def test_router_reads_are_locked_snapshots():
+    """PTA005 fix regression: every Router read goes through a locked
+    snapshot — mutating a returned table cannot corrupt the router, and
+    add_model's return value is the hosted record itself (previously an
+    unlocked re-read of the shared dict)."""
+    from paddle_tpu.serve.router import Router
+
+    router = Router()  # no telemetry env in tests -> steplog stays off
+    hosted = router.add_model("m", bundle=None, engine=_FakeEngine())
+    assert router.model("m") is hosted
+    snapshot = router.models()
+    snapshot.clear()  # a copy: must not unhost the model
+    assert router.model("m") is hosted
+    assert router.total_queued() == 2
+    assert router.ready() and router.live()
+    router.stop()
+    assert not router.live()
+
+
+class _StubBundle:
+    """Minimal bundle for engine lifecycle tests (no device work)."""
+
+    name = "stub"
+    inputs = [{"name": "x", "kind": "dense", "dim": 2,
+               "dtype": "float32"}]
+    buckets = [{"batch": 4}]
+
+    def max_batch(self):
+        return 4
+
+    def warmup(self):
+        return 1
+
+    def validate_inputs(self, flat):
+        pass
+
+    def bucket_for(self, rows):
+        return {"batch": 4}
+
+    def run(self, flat, batch):
+        return {"y": np.zeros((batch, 1), np.float32)}
+
+
+def test_engine_live_locked_read_regression():
+    """PTA005 fix regression: live() now reads _stopped under the
+    engine lock; the observable contract (live while running, not live
+    after stop, requests still served) is unchanged."""
+    from paddle_tpu.serve.engine import InferenceEngine
+
+    engine = InferenceEngine(_StubBundle(), max_latency_ms=1.0)
+    try:
+        assert engine.live()
+        out = engine.infer({"x": np.zeros((2, 2), np.float32)})
+        assert out["y"].shape == (2, 1)
+    finally:
+        engine.stop()
+    assert not engine.live()
+
+
+# ---- static HBM footprint estimate ----------------------------------------
+
+def test_hbm_budget_parse():
+    hbm = topology_check.hbm_budget_bytes
+    assert hbm(env="16G") == 16 * 1024 ** 3
+    assert hbm(env="512MB") == 512 * 1024 ** 2
+    assert hbm(env="2K") == 2048
+    assert hbm(env="123") == 123
+    assert hbm(env="") is None
+    assert hbm(env="chips") is None
+
+
+def _state_nbytes(trainer):
+    import jax
+
+    state = (trainer._trainable, trainer._static, trainer._state,
+             trainer._opt_state)
+    return sum(int(x.nbytes) for x in jax.tree_util.tree_leaves(state))
+
+
+def _feed_nbytes(feed):
+    import jax
+
+    return sum(int(np.asarray(x).nbytes)
+               for x in jax.tree_util.tree_leaves(feed))
+
+
+def test_hbm_estimate_matches_live_dense():
+    """Acceptance pin #1: the static resident-bytes estimate (params +
+    optimizer slots + feed) agrees with live device ``nbytes`` on the
+    dense MNIST-style program within 25%."""
+    import jax
+
+    from paddle_tpu.topology import convert_feed
+
+    data = _dense_batches(3)
+    cost = _dense_model()
+    params = Parameters.create(cost)
+    optimizer = opt.Momentum(learning_rate=1e-2, momentum=0.9)
+    trainer = paddle.trainer.SGD(cost, params, optimizer)
+    trainer.train(lambda: iter(data), num_passes=1)
+
+    topo = Topology(_dense_model())
+    pred = topology_check.predict_jit_entries(
+        topo, lambda: iter(data), parameters=params, optimizer=optimizer)
+    assert pred["hbm_peak_bytes"] > 0
+    entry = pred["entries"][0]
+    est = entry["hbm"]["resident"]
+    live = _state_nbytes(trainer) + _feed_nbytes(
+        convert_feed(topo, data[0]))
+    assert abs(est - live) / live <= 0.25, (est, live)
+
+
+def test_hbm_estimate_matches_live_bucketed_tagging():
+    """Acceptance pin #2: same agreement on the bucketed tagging
+    program — sequence feeds pad to their bucket, Adam carries 2x
+    slots."""
+    from paddle_tpu.data import bucketing
+    from paddle_tpu.topology import convert_feed
+
+    samples = _seq_samples(32, seed=3)
+    cost = _tagging_model()
+    params = Parameters.create(cost)
+    optimizer = opt.Adam(learning_rate=1e-2)
+    trainer = paddle.trainer.SGD(cost, params, optimizer)
+    trainer.train(_tagging_reader(samples), num_passes=1,
+                  buckets={"boundaries": BUCKETS, "drop_remainder": True})
+
+    topo = Topology(_tagging_model())
+    pred = topology_check.predict_jit_entries(
+        topo, _tagging_reader(samples),
+        buckets={"boundaries": BUCKETS, "drop_remainder": True},
+        parameters=params, optimizer=optimizer)
+    entry = max(pred["entries"], key=lambda e: e["hbm"]["resident"])
+    pad = max(entry["seq_pad"].values())
+    reader = bucketing.rebucket_batches(
+        _tagging_reader(samples), buckets=BUCKETS, drop_remainder=True,
+        length_of=bucketing.topology_length_of(topo, None))
+    feed = None
+    for batch in reader():
+        if len(batch) == entry["rows"] and int(batch.bucket) == pad:
+            feed = convert_feed(topo, batch, max_len=batch.bucket)
+            break
+    assert feed is not None
+    est = entry["hbm"]["resident"]
+    live = _state_nbytes(trainer) + _feed_nbytes(feed)
+    assert abs(est - live) / live <= 0.25, (est, live)
+
+
+def test_pretrain_check_hbm_budget_warning(monkeypatch):
+    """The trainer-side budget gate: with PADDLE_TPU_HBM_BUDGET set
+    below the parameter-side footprint, pretrain_check warns before the
+    first dispatch; with a generous budget it stays quiet."""
+    cost = _dense_model()
+    params = Parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost, params, opt.Momentum(learning_rate=1e-2, momentum=0.9))
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", "1")
+    report = topology_check.pretrain_check(trainer)
+    assert report["hbm"]["params"] > 0
+    assert any("PADDLE_TPU_HBM_BUDGET" in w for w in report["warnings"])
+    assert "hbm estimate" in topology_check.format_report(report)
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", "1G")
+    report = topology_check.pretrain_check(trainer)
+    assert not any("PADDLE_TPU_HBM_BUDGET" in w
+                   for w in report["warnings"])
+
+
+def test_export_bundle_records_hbm_estimate(tmp_path):
+    """Export-side wiring: the manifest carries the static footprint of
+    the largest exported program."""
+    reset_name_counters()
+    x = L.data(name="x", type=dt.dense_vector(4))
+    out = L.fc(input=x, size=2)
+    params = Parameters.create(out)
+    from paddle_tpu.serve.export import export_bundle
+
+    manifest = export_bundle(out, params, str(tmp_path / "bundle"),
+                             batch_sizes=(1, 2))
+    assert manifest["hbm_estimate_bytes"] > 0
 
 
 # ---- reject_packed coverage (derived, not hand-listed) ---------------------

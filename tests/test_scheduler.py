@@ -212,6 +212,9 @@ def test_scheduler_smoke_admit_retire_reuse(decode_bundle):
 
     with ContinuousScheduler(decode_bundle,
                              metrics_registry=MetricsRegistry()) as sched:
+        # liveness reads _stopped under the scheduler lock (PTA005 fix
+        # regression): live while running, not live once stopped
+        assert sched.live()
         # wire formats: bare [T], [1, T], and [1, T] + lens
         f1 = sched.submit({"word": np.array([1, 2, 3], np.int32)})
         f2 = sched.submit({"word": np.array([[4, 5]], np.int32)})
@@ -230,6 +233,7 @@ def test_scheduler_smoke_admit_retire_reuse(decode_bundle):
             sched.submit({"wrong": np.array([1], np.int32)})
         with pytest.raises(ValueError, match="empty"):
             sched.submit({"word": np.zeros((0,), np.int32)})
+    assert not sched.live()
     with pytest.raises(RuntimeError, match="stopped"):
         sched.submit({"word": np.array([1], np.int32)})
 
