@@ -101,6 +101,7 @@ HOT_PATHS = {
     "serve/scheduler.py": {"submit", "_loop", "_run_iteration",
                            "_distribute", "_admit"},
     "serve/router.py": {"submit", "total_queued"},
+    "serve/fleet.py": {"submit", "queue_depth", "_eligible"},
     "data/feeder.py": {"_produce", "batches", "chunks"},
     # per-step dispatch paths that predate PTA001: the cluster worker's
     # whole train loop and the mesh strategy's per-step wrappers

@@ -348,15 +348,34 @@ def cmd_export(args):
 def _make_engine(bundle, args, reg, model=None, warmup="async"):
     from paddle_tpu.serve import ContinuousScheduler, InferenceEngine
 
+    if args.continuous and not bundle.has_decoder():
+        # refuse loudly: silently falling back to the padding
+        # engine would leave the operator believing continuous
+        # batching is active
+        print("--continuous: bundle %r has no decode artifacts; "
+              "re-export with --decode-slots" % bundle.name,
+              file=sys.stderr)
+        raise SystemExit(2)
+    replicas = getattr(args, "replicas", "") or ""
+    if replicas:
+        # replica scaling (docs/serving.md "Replica scaling"): ONE
+        # bundle onto N devices as N shared-nothing engines behind a
+        # least-queued dispatch front, duck-typed like a single engine
+        import jax
+
+        from paddle_tpu.serve import ReplicaSet
+
+        n = (len(jax.devices()) if replicas == "auto"
+             else int(replicas))
+        kwargs = ({"max_queue": args.max_queue_rows} if args.continuous
+                  else {"max_batch_size": args.max_batch_size,
+                        "max_latency_ms": args.max_latency_ms,
+                        "max_queue_rows": args.max_queue_rows})
+        return ReplicaSet(bundle, replicas=n,
+                          continuous=args.continuous,
+                          engine_kwargs=kwargs, metrics_registry=reg,
+                          model=model, warmup=warmup)
     if args.continuous:
-        if not bundle.has_decoder():
-            # refuse loudly: silently falling back to the padding
-            # engine would leave the operator believing continuous
-            # batching is active
-            print("--continuous: bundle %r has no decode artifacts; "
-                  "re-export with --decode-slots" % bundle.name,
-                  file=sys.stderr)
-            raise SystemExit(2)
         return ContinuousScheduler(
             bundle, warmup=warmup, metrics_registry=reg, model=model,
             max_queue=args.max_queue_rows)
@@ -454,6 +473,23 @@ def cmd_serve(args):
     return 0
 
 
+def cmd_generate(args):
+    """Streaming generation over a decode-capable bundle
+    (docs/serving.md "Streaming generation"): loop the exported decode
+    step host-side, feed each sampled y_t back as x_{t+1}. Greedy at
+    --temperature 0 (default), seeded sampling otherwise."""
+    from paddle_tpu.serve import load_bundle
+    from paddle_tpu.serve.generate import generate
+
+    bundle = load_bundle(args.bundle)
+    prime = [int(t) for t in args.prime.split(",") if t.strip()]
+    out = generate(bundle, prime, args.steps,
+                   temperature=args.temperature, seed=args.seed,
+                   slots=args.slots)
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_observe(args):
     """Summarize a PADDLE_TPU_TELEMETRY directory: per-run step counts,
     steady-state wall-time p50/p95/p99, compile-event totals, and the
@@ -516,6 +552,13 @@ def cmd_observe(args):
         if "cost_last" in run:
             print("    cost: first %.6f -> last %.6f"
                   % (run["cost_first"], run["cost_last"]))
+        for rep, s in sorted(run.get("serve_replicas", {}).items()):
+            print("    serve replica %-4s dispatches %-6d "
+                  "completed %-6d%s%s"
+                  % (rep, s["dispatches"], s["completed"],
+                     ("  qps %.1f" % s["qps"]) if "qps" in s else "",
+                     ("  occupancy %.2f" % s["occupancy_mean"])
+                     if "occupancy_mean" in s else ""))
     if summary["trace_files"]:
         print("  traces (open in https://ui.perfetto.dev): %s"
               % ", ".join(summary["trace_files"]))
@@ -761,6 +804,25 @@ def main(argv=None):
                    help="decode timesteps per dispatch (default 8)")
     p.set_defaults(fn=cmd_export)
 
+    p = sub.add_parser("generate")
+    p.add_argument("bundle",
+                   help="decode-capable bundle directory "
+                        "(exported with --decode-slots)")
+    p.add_argument("--prime", required=True,
+                   help="comma-separated token ids to prime the carry "
+                        "with (e.g. 5,17,3)")
+    p.add_argument("--steps", type=int, default=32,
+                   help="tokens to generate after the prime")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy argmax; >0 samples from the "
+                        "temperature-scaled distribution")
+    p.add_argument("--seed", type=int, default=0,
+                   help="sampling seed (reproducible output)")
+    p.add_argument("--slots", type=int, default=None,
+                   help="decode artifact to use (default: largest "
+                        "exported slot capacity)")
+    p.set_defaults(fn=cmd_generate)
+
     p = sub.add_parser("serve")
     p.add_argument("bundle", nargs="?", default="",
                    help="exported bundle directory (single-model mode)")
@@ -774,6 +836,12 @@ def main(argv=None):
                    help="front decode-capable bundles with the "
                         "continuous-batching scheduler instead of the "
                         "whole-request batcher")
+    p.add_argument("--replicas", default="",
+                   help="N|auto: load each bundle onto N devices as N "
+                        "shared-nothing engine replicas behind one "
+                        "least-queued dispatch front (auto = one per "
+                        "visible device); /metrics gains {replica=} "
+                        "labels, /readyz is all-replicas-warm")
     p.add_argument("--selfcheck", action="store_true",
                    help="load, warm, run one batch, exit (smoke gate)")
     p.add_argument("--host", default="127.0.0.1")

@@ -18,6 +18,14 @@ Three pillars (docs/serving.md):
 * :class:`Router` (serve/router.py) — multi-model hosting with
   priority classes, bounded queues and :class:`Overloaded` load
   shedding (the HTTP 429 path).
+* :class:`ReplicaSet` (serve/fleet.py) — replica scaling: ONE bundle
+  loaded onto N devices as N shared-nothing engine (or scheduler)
+  replicas behind a least-queued dispatch front; duck-typed like an
+  engine so the Router/HTTP front door host it unchanged
+  (``cli serve --replicas N|auto``).
+* :func:`generate` (serve/generate.py) — streaming generation: a
+  host-side loop over the exported decode step feeding y_t back as
+  x_{t+1} (``cli generate``).
 
 ``paddle_tpu.cli export`` / ``cli serve`` wrap the three from the
 command line; ``paddle_tpu/capi`` loads bundles through the same
@@ -28,8 +36,11 @@ from :func:`load_bundle` stay free of the graph machinery —
 ``export_bundle`` (which does build the graph) is lazy-loaded.
 """
 
-from paddle_tpu.serve.bundle import Bundle, is_bundle, load_bundle
+from paddle_tpu.serve.bundle import (Bundle, BundleReplica, is_bundle,
+                                     load_bundle)
 from paddle_tpu.serve.engine import InferenceEngine, Overloaded
+from paddle_tpu.serve.fleet import ReplicaSet
+from paddle_tpu.serve.generate import generate
 from paddle_tpu.serve.router import Router
 from paddle_tpu.serve.scheduler import ContinuousScheduler
 
@@ -43,6 +54,7 @@ def __getattr__(name):
                          % name)
 
 
-__all__ = ["Bundle", "ContinuousScheduler", "InferenceEngine",
-           "Overloaded", "Router", "export_bundle", "is_bundle",
-           "load_bundle", "verify_bundle"]
+__all__ = ["Bundle", "BundleReplica", "ContinuousScheduler",
+           "InferenceEngine", "Overloaded", "ReplicaSet", "Router",
+           "export_bundle", "generate", "is_bundle", "load_bundle",
+           "verify_bundle"]

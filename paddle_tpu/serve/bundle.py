@@ -86,8 +86,13 @@ class Bundle:
         # params transfer to the device ONCE (lazily): the npz payload
         # loads as numpy, and passing numpy into every executable call
         # re-uploads ~the whole parameter set per dispatch — measured at
-        # 3x the per-iteration cost of the continuous decode loop
-        self._device_params = None
+        # 3x the per-iteration cost of the continuous decode loop.
+        # Keyed BY TARGET DEVICE (None = default placement): a replica
+        # fleet (serve/fleet.py) shares one Bundle across N devices, and
+        # a single cache slot would re-upload on every device switch —
+        # or worse, serve every replica from whichever device won the
+        # race. One entry per device, each uploaded exactly once.
+        self._device_params = {}
         self._executables = {}  # batch -> jax.export.Exported
         # the engine's async-warmup thread and its batcher worker can
         # both reach a cold bucket; the lock stops them deserializing
@@ -163,23 +168,27 @@ class Bundle:
                                           int(lens.min()), int(lens.max())))
 
     # -- execution ----------------------------------------------------------
-    def params(self):
+    def params(self, device=None):
         """The parameter payload as DEVICE-resident arrays (uploaded on
-        first use, cached): every executable call site feeds from here
-        so a serving process pays the host-to-device copy once, not
-        once per dispatch."""
+        first use, cached per target device): every executable call site
+        feeds from here so a serving process pays the host-to-device
+        copy once per device, not once per dispatch. ``device=None`` is
+        the default placement; a replica fleet passes each replica's
+        device so N replicas hold N independent copies without ever
+        thrashing each other's cache entry."""
         # double-checked init: the unlocked read is the per-dispatch fast
-        # path; a stale None only sends the reader into the locked slow
+        # path; a stale miss only sends the reader into the locked slow
         # path below, which re-reads under _exe_lock (GIL-atomic load)
-        dp = self._device_params  # paddle-lint: disable=PTA005
+        dp = self._device_params.get(device)  # paddle-lint: disable=PTA005
         if dp is None:
             with self._exe_lock:
-                dp = self._device_params
+                dp = self._device_params.get(device)
                 if dp is None:
                     import jax
 
-                    dp = self._device_params = jax.device_put(
-                        self._params)
+                    dp = (jax.device_put(self._params) if device is None
+                          else jax.device_put(self._params, device))
+                    self._device_params[device] = dp
         return dp
 
     def executable(self, batch):
@@ -203,12 +212,13 @@ class Bundle:
                     self._executables[batch] = exe
         return exe
 
-    def warmup(self):
+    def warmup(self, device=None):
         """Deserialize AND run every bucket once so serving never pays a
-        first-request compile (the engine calls this at start)."""
+        first-request compile (the engine calls this at start; a fleet
+        replica warms its own device's placement)."""
         for bucket in self.buckets:
             batch = bucket["batch"]
-            self.executable(batch).call(self.params(),
+            self.executable(batch).call(self.params(device),
                                         self.dummy_inputs(batch))
         return len(self.buckets)
 
@@ -264,11 +274,37 @@ class Bundle:
                     self._executables[key] = exe
         return exe
 
-    def zero_carry(self, slots=None):
+    def _decode_fn(self, slots=None):
+        """The decode step as a cached ``jax.jit`` wrapper around the
+        exported call. ``Exported.call`` dispatches through the Python
+        primitive-bind path (~1ms of GIL-held work per call at the
+        tagger shape — measured at ~12%% of a saturated scheduler
+        iteration, and it SERIALIZES across fleet replicas); the jit
+        wrapper hits the C++ dispatch fast path instead. The carry is
+        re-donated at this boundary so slot state still never
+        round-trips the host. One wrapper per slot capacity; the jit
+        cache keys placements, so N replicas share it."""
+        key = "decode_fn_s%d" % int(self._decode_bucket(slots)["slots"])
+        fn = self._executables.get(key)  # paddle-lint: disable=PTA005
+        if fn is None:
+            exe_call = self.decode_executable(slots).call
+            with self._exe_lock:
+                fn = self._executables.get(key)
+                if fn is None:
+                    import jax
+
+                    fn = jax.jit(exe_call, donate_argnums=(1,))
+                    self._executables[key] = fn
+        return fn
+
+    def zero_carry(self, slots=None, device=None):
         """The virgin recurrent carry for one slot capacity:
         ``{recurrent_layer_name: [np.zeros([slots, ...]), ...]}`` per
         the manifest's carry spec — what every slot boots from and what
-        ``reset`` re-zeroes admitted slots to."""
+        ``reset`` re-zeroes admitted slots to. With ``device`` the
+        leaves are committed there up front, so a replica's very first
+        dispatch already carries the steady-state (device-resident)
+        jit signature instead of minting a one-shot host-staged one."""
         slots = int(self._decode_bucket(slots)["slots"])
         carry = {}
         for layer, leaves in self.manifest["decode"]["carry"].items():
@@ -276,16 +312,20 @@ class Bundle:
                 np.zeros((slots,) + tuple(leaf["shape_suffix"]),
                          _np_dtype(leaf["dtype"]))
                 for leaf in leaves]
+        if device is not None:
+            import jax
+
+            carry = jax.device_put(carry, device)
         return carry
 
-    def decode_step(self, carry, flat, slots=None):
+    def decode_step(self, carry, flat, slots=None, device=None):
         """Run ONE decode window: ``(carry, flat) -> (carry', outputs)``
         with everything still device-resident — the scheduler owns the
         (single, sanctioned) readback of ``outputs`` inside its
         ``serve_decode`` span and threads ``carry'`` straight into the
-        next dispatch (the carry is donated at export)."""
-        return self.decode_executable(slots).call(self.params(), carry,
-                                                  flat)
+        next dispatch (the carry is donated both at export and at the
+        jit-wrapper boundary, :meth:`_decode_fn`)."""
+        return self._decode_fn(slots)(self.params(device), carry, flat)
 
     def dummy_decode_flat(self, slots=None, window=None):
         """Zero-valued decode-step inputs (warmup/selfcheck)."""
@@ -300,25 +340,34 @@ class Bundle:
             flat[spec["name"]] = np.zeros(shape, dtype)
         return flat
 
-    def warmup_decoder(self, slots=None):
-        """Deserialize AND run the decode step once so the scheduler
-        never pays a first-request compile."""
+    def warmup_decoder(self, slots=None, device=None):
+        """Deserialize AND run the decode step so the scheduler never
+        pays a first-request compile. TWO dispatches on purpose: a
+        fresh (host-staged numpy) carry and the device-resident carry
+        it returns are distinct jit signatures — warming only the first
+        would leave the steady-state compile to the scheduler's second
+        real iteration (it did, until the replica-fleet compile gate
+        caught it)."""
         bucket = self._decode_bucket(slots)
-        carry = self.zero_carry(bucket["slots"])
-        self.decode_step(carry, self.dummy_decode_flat(bucket["slots"]),
-                         bucket["slots"])
-        return int(bucket["slots"])
+        slot_count = int(bucket["slots"])
+        carry = self.zero_carry(slot_count, device=device)
+        carry, _ = self.decode_step(carry,
+                                    self.dummy_decode_flat(slot_count),
+                                    slot_count, device=device)
+        self.decode_step(carry, self.dummy_decode_flat(slot_count),
+                         slot_count, device=device)
+        return slot_count
 
-    def run(self, flat_inputs, batch):
+    def run(self, flat_inputs, batch, device=None):
         """Run one exact-bucket batch (no padding logic). Returns
         {output_name: np.ndarray} — THE sanctioned readback point of
         the serving path: callers get host arrays by contract, and the
         engine wraps this call in its ``serve_batch`` span."""
-        out = self.executable(batch).call(self.params(), flat_inputs)
+        out = self.executable(batch).call(self.params(device), flat_inputs)
         return {k: np.asarray(v)  # paddle-lint: disable=PTA001
                 for k, v in out.items()}
 
-    def infer(self, flat_inputs, rows=None):
+    def infer(self, flat_inputs, rows=None, device=None):
         """Pad ``flat_inputs`` to the nearest exported bucket, run, slice
         the padding back off. ``flat_inputs`` maps flat feed keys to
         arrays with a leading row dimension."""
@@ -330,13 +379,69 @@ class Bundle:
         bucket = self.bucket_for(rows)
         padded = {k: pad_rows(np.asarray(v), bucket["batch"])
                   for k, v in flat_inputs.items()}
-        out = self.run(padded, bucket["batch"])
+        out = self.run(padded, bucket["batch"], device=device)
         return {k: arr[:rows] for k, arr in out.items()}
+
+    def view(self, device):
+        """A device-pinned :class:`BundleReplica` view of this bundle —
+        same manifest, same deserialized-executable cache, params placed
+        onto (and cached for) ``device``. The unit a replica fleet
+        (serve/fleet.py) hands each shared-nothing engine."""
+        return BundleReplica(self, device)
 
     def __repr__(self):
         return "Bundle(%r, buckets=%s, inputs=%s)" % (
             self.name, self.batch_sizes(),
             [i["name"] for i in self.inputs])
+
+
+class BundleReplica:
+    """A device-pinned view over a shared :class:`Bundle`.
+
+    N fleet replicas load ONE bundle: the manifest, the packed numpy
+    payload and the deserialized ``jax.export`` artifacts are all
+    per-process state shared through the base bundle, while every
+    *execution* entry point (``run``/``infer``/``warmup``/
+    ``decode_step``/``warmup_decoder``/``params``) targets this view's
+    device, so each replica feeds from its own device-resident parameter
+    copy (``Bundle.params(device=...)``) and its dispatches land on its
+    own chip. Everything else delegates to the base bundle, which keeps
+    the view duck-type compatible with ``Bundle`` for the engines."""
+
+    def __init__(self, base, device):
+        self._base = base
+        self.device = device
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+    def params(self, device=None):
+        return self._base.params(device=device or self.device)
+
+    def run(self, flat_inputs, batch):
+        return self._base.run(flat_inputs, batch, device=self.device)
+
+    def infer(self, flat_inputs, rows=None):
+        return self._base.infer(flat_inputs, rows, device=self.device)
+
+    def warmup(self):
+        return self._base.warmup(device=self.device)
+
+    def decode_step(self, carry, flat, slots=None):
+        return self._base.decode_step(carry, flat, slots,
+                                      device=self.device)
+
+    def zero_carry(self, slots=None):
+        # committed to this view's device so the first dispatch already
+        # runs the steady-state jit signature (one program per replica)
+        return self._base.zero_carry(slots, device=self.device)
+
+    def warmup_decoder(self, slots=None):
+        return self._base.warmup_decoder(slots, device=self.device)
+
+    def __repr__(self):
+        return "BundleReplica(%r, device=%s)" % (self._base.name,
+                                                 self.device)
 
 
 def pad_rows(arr, to_rows):

@@ -81,17 +81,24 @@ class InferenceEngine:
 
     def __init__(self, bundle, max_batch_size=None, max_latency_ms=5.0,
                  steplog=None, warmup=True, run_name="serve",
-                 metrics_registry=None, model=None, max_queue_rows=None):
+                 metrics_registry=None, model=None, max_queue_rows=None,
+                 replica=None):
         self.bundle = bundle
         # multi-model serving (serve/router.py): ``model`` labels every
         # metric family of this engine with {model=...} so one registry
-        # tells N hosted bundles apart; ``max_queue_rows`` bounds the
-        # queue — submit() raises Overloaded instead of letting the
+        # tells N hosted bundles apart; ``replica`` likewise adds a
+        # {replica=...} label (and an additive ``replica`` field on
+        # serve_batch steplog records) when this engine is one member of
+        # a replica fleet (serve/fleet.py); ``max_queue_rows`` bounds
+        # the queue — submit() raises Overloaded instead of letting the
         # backlog (and every accepted request's latency) grow unbounded
         self.model = model
+        self.replica = None if replica is None else str(replica)
         self.max_queue_rows = (None if max_queue_rows is None
                                else int(max_queue_rows))
         self._labels = {"model": str(model)} if model else {}
+        if self.replica is not None:
+            self._labels["replica"] = self.replica
         self.max_batch_size = int(max_batch_size or bundle.max_batch())
         if self.max_batch_size > bundle.max_batch():
             raise ValueError(
@@ -111,8 +118,11 @@ class InferenceEngine:
         self._stats = collections.Counter()
         self._per_bucket = {}  # bucket batch -> Counter(batches/rows/pad)
         self._owns_slog = steplog is None
+        # serving records arrive at request rate: batch the flush
+        # (crash loses <32 records, not the throughput — steplog.py)
         self._slog = (observe_steplog.from_env(run_name=run_name,
-                                               meta={"phase": "serve"})
+                                               meta={"phase": "serve"},
+                                               flush_every=32)
                       if steplog is None else steplog)
         self.metrics = metrics_registry or observe_metrics.get_registry()
         self._build_metrics()
@@ -132,15 +142,23 @@ class InferenceEngine:
                     pass           # the engine simply stays not-ready
 
             threading.Thread(target=_bg_warmup,
-                             name="serve-warmup", daemon=True).start()
+                             name=self._thread_name("serve-warmup"),
+                             daemon=True).start()
         elif warmup:
             self._warmup()
         else:
             self._ready.set()
             self._m_ready.set(1)
-        self._worker = threading.Thread(target=self._loop,
-                                        name="serve-batcher", daemon=True)
+        self._worker = threading.Thread(
+            target=self._loop, name=self._thread_name("serve-batcher"),
+            daemon=True)
         self._worker.start()
+
+    def _thread_name(self, base):
+        """Thread names carry the replica index so a fleet's N workers
+        are tellable apart in a stack dump."""
+        return (base if self.replica is None
+                else "%s-r%s" % (base, self.replica))
 
     def _warmup(self):
         try:
@@ -283,6 +301,8 @@ class InferenceEngine:
                 out.setdefault(key, 0)
             if self.model:
                 out["model"] = self.model
+            if self.replica is not None:
+                out["replica"] = self.replica
             out["queue_depth"] = self._queued_rows
             out["queued_rows"] = self._queued_rows  # back-compat alias
             out["in_flight"] = self._in_flight
@@ -395,7 +415,7 @@ class InferenceEngine:
                 rows=rows, bucket=bucket["batch"], infer_ms=infer_ms,
                 batch_id=batch_id, pad_rows=bucket["batch"] - rows,
                 requests=len(requests), queue_ms_max=queue_ms_max,
-                flush=reason)
+                flush=reason, replica=self.replica)
         pad = bucket["batch"] - rows
         with self._cv:
             self._stats["batches"] += 1

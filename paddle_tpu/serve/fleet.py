@@ -1,0 +1,273 @@
+"""Replica-scaled serving: shared-nothing per-device engines behind one
+front door (docs/serving.md "Replica scaling").
+
+A multi-chip host serving through one engine runs at 1/N of its
+hardware: the engine's worker serializes every dispatch onto one
+device. :class:`ReplicaSet` is the reference's scaling shape brought to
+the serve tier — the 2017 system scaled by running many shared-nothing
+trainer/pserver replicas behind one coordination front door — applied
+per device instead of per host:
+
+* ONE :class:`~paddle_tpu.serve.bundle.Bundle` loads once (manifest,
+  packed params, deserialized artifacts are process-shared); each
+  replica gets a device-pinned :class:`~paddle_tpu.serve.bundle
+  .BundleReplica` view, so parameters are ``jax.device_put`` onto that
+  replica's device exactly once (``Bundle.params(device=...)``, keyed
+  per device).
+* each replica runs its OWN engine — whole-request batcher
+  (serve/engine.py) or continuous-batching scheduler
+  (serve/scheduler.py) — with its own queue, worker thread and
+  ``{replica=...}``-labeled metric families. Nothing is shared between
+  replicas but the read-only bundle: no cross-replica lock sits on the
+  dispatch path.
+* ``submit()`` dispatches each request to the **least-queued** eligible
+  replica (fewest queued rows; round-robin tie-break so an idle fleet
+  still spreads warm-cache load evenly). A replica is eligible once its
+  warmup completed and its worker is alive — a cold or dead replica
+  never sees traffic, and ``ready()`` stays False (503 on ``/readyz``)
+  until EVERY replica is warm, the all-replicas-warm contract.
+
+The fleet is duck-type compatible with the engines
+(submit/infer/ready/live/queue_depth/stats/stop), so the Router and the
+HTTP front end (serve/server.py) host a ReplicaSet exactly like a
+single engine: ``/infer``, 429 shedding, ``/metrics`` (now with
+``{replica=}`` labels), ``/readyz`` and steplog records all work
+unchanged. ``cli serve <bundle> --replicas N|auto`` is the command-line
+surface; the audited throughput proof is ``benchmark/exp_serve.py
+--mode replicas-ab`` (docs/serving.md).
+
+Capacity safety: an N-replica fleet holds N parameter copies. The
+bundle manifest's static ``hbm_estimate_bytes`` (export-time analyzer
+estimate) times N is checked against ``PADDLE_TPU_HBM_BUDGET`` at
+construction — BEFORE the first ``device_put`` — so a fleet that cannot
+fit N copies fails loudly at build time, not at the k-th replica's
+first dispatch.
+"""
+
+import threading
+
+from paddle_tpu.observe import metrics as observe_metrics
+from paddle_tpu.observe import steplog as observe_steplog
+from paddle_tpu.serve.engine import InferenceEngine, Overloaded
+from paddle_tpu.serve.scheduler import ContinuousScheduler
+
+
+class Replica:
+    """One fleet member: index, device, device-pinned bundle view and
+    the shared-nothing engine that serves it."""
+
+    __slots__ = ("index", "device", "bundle", "engine")
+
+    def __init__(self, index, device, bundle, engine):
+        self.index = index
+        self.device = device
+        self.bundle = bundle
+        self.engine = engine
+
+    def __repr__(self):
+        return "Replica(%d, device=%s)" % (self.index, self.device)
+
+
+def fleet_hbm_check(bundle, replicas):
+    """Static HBM gate for an N-replica load: the manifest's export-time
+    ``hbm_estimate_bytes`` times ``replicas`` against
+    ``PADDLE_TPU_HBM_BUDGET``. Returns ``(total_bytes, note)`` —
+    ``note`` is None when the load fits (or no budget/estimate exists)
+    and the warning text otherwise. Runs before any ``device_put`` so
+    an unfittable fleet warns at construction, not mid-warmup."""
+    est = bundle.manifest.get("hbm_estimate_bytes")
+    if not est:
+        return None, None
+    total = int(est) * int(replicas)
+    # lazy import: topology_check is ast+os only, but keep the serving
+    # fast path free of analyze imports it never needs
+    from paddle_tpu.analyze.topology_check import (_fmt_bytes,
+                                                   hbm_budget_bytes)
+
+    budget = hbm_budget_bytes()
+    if budget is None or total <= budget:
+        return total, None
+    note = ("%d-replica fleet needs ~%s of device memory (%s params+"
+            "workspace per replica, manifest hbm_estimate_bytes), over "
+            "PADDLE_TPU_HBM_BUDGET=%s — N parameter copies will not "
+            "fit; serve fewer replicas or a smaller bundle"
+            % (replicas, _fmt_bytes(total), _fmt_bytes(int(est)),
+               _fmt_bytes(budget)))
+    from paddle_tpu.utils.logger import logger
+
+    logger.warning("ReplicaSet: %s", note)
+    return total, note
+
+
+class ReplicaSet:
+    """N shared-nothing engine replicas over one bundle, one per device,
+    behind a least-queued dispatch front. Duck-type compatible with
+    :class:`~paddle_tpu.serve.engine.InferenceEngine` so the Router and
+    the HTTP server host it unchanged.
+
+    ``replicas`` defaults to one per visible device; ``devices`` pins
+    the placement explicitly (cycled when ``replicas`` exceeds it — the
+    single-device case tier-1 exercises). ``continuous=True`` fronts a
+    decode-capable bundle with :class:`ContinuousScheduler` replicas
+    instead of the whole-request batcher; ``engine_kwargs`` passes
+    through to every member engine (``max_latency_ms``,
+    ``max_queue_rows`` / ``max_queue``, ...).
+    """
+
+    def __init__(self, bundle, replicas=None, devices=None,
+                 continuous=False, engine_kwargs=None,
+                 metrics_registry=None, steplog=None, model=None,
+                 warmup=True, run_name="serve"):
+        import jax
+
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        if not devices:
+            raise ValueError("no devices to place replicas on")
+        n = len(devices) if replicas is None else int(replicas)
+        if n < 1:
+            raise ValueError("replicas must be >= 1, got %r" % replicas)
+        self.bundle = bundle
+        self.model = model
+        self.continuous = bool(continuous)
+        self.metrics = metrics_registry or observe_metrics.get_registry()
+        # shared-nothing INCLUDES the telemetry sink: a single StepLog
+        # across N replicas serializes every hot-path record on one
+        # lock and one fd (measured: it erased the fleet's throughput
+        # win under PADDLE_TPU_TELEMETRY). By default each replica
+        # engine opens its own per-replica run file
+        # (<run>-r<i>.steps.jsonl, records carry the replica field); an
+        # explicitly passed ``steplog`` is shared — the single-file
+        # form tests use.
+        self._slog = steplog
+        # the static capacity gate runs BEFORE the first device_put
+        self.hbm_estimate_bytes, self.hbm_note = fleet_hbm_check(bundle, n)
+        shed_labels = {"reason": "no_replica"}
+        if model:
+            shed_labels["model"] = str(model)
+        self._m_shed = self.metrics.counter(
+            "paddle_tpu_serve_shed_total",
+            help="requests rejected by admission control",
+            labels=shed_labels)
+        kwargs = dict(engine_kwargs or {})
+        engine_cls = ContinuousScheduler if continuous else InferenceEngine
+        members = []
+        for i in range(n):
+            device = devices[i % len(devices)]
+            view = bundle.view(device)
+            engine = engine_cls(view, steplog=self._slog, warmup=warmup,
+                                metrics_registry=self.metrics,
+                                model=model, replica=i,
+                                run_name="%s-r%d" % (run_name, i),
+                                **kwargs)
+            members.append(Replica(i, device, view, engine))
+        # the member list is immutable after construction — dispatch
+        # reads it lock-free; only the round-robin cursor needs a lock
+        self._members = tuple(members)
+        self._lock = threading.Lock()
+        self._rr = 0
+
+    def replicas(self):
+        """The fleet members, in index order (immutable tuple)."""
+        return self._members
+
+    # -- dispatch -----------------------------------------------------------
+    def _eligible(self):
+        """Members that may receive traffic: warm AND alive. A replica
+        whose warmup failed (or whose worker died) is excluded here —
+        and keeps the aggregate ``ready()`` False — until it recovers."""
+        return [m for m in self._members
+                if m.engine.ready() and m.engine.live()]
+
+    def submit(self, inputs):
+        """Dispatch one request to the least-queued eligible replica
+        (round-robin among ties); returns that engine's Future. The
+        depth reads are a point-in-time heuristic — two concurrent
+        submitters may pick the same replica, which costs one queue slot
+        of imbalance, not correctness. Raises
+        :class:`~paddle_tpu.serve.engine.Overloaded` when no replica is
+        eligible (still warming, or every worker dead) or when the
+        chosen replica's own queue bound sheds."""
+        eligible = self._eligible()
+        if not eligible:
+            self._m_shed.inc()
+            raise Overloaded(
+                "no warm live replica (fleet of %d still warming or "
+                "failed) — retry after /readyz goes green"
+                % len(self._members),
+                model=self.model, reason="no_replica")
+        n = len(eligible)
+        with self._lock:
+            offset = self._rr
+            self._rr = (self._rr + 1) % n
+        # rotate the candidate order by the round-robin cursor, then
+        # take the first minimum: equal queue depths spread evenly,
+        # unequal ones always pick the shortest queue
+        order = [eligible[(offset + j) % n] for j in range(n)]
+        depths = [m.engine.queue_depth() for m in order]
+        best = min(range(n), key=lambda j: (depths[j], j))
+        return order[best].engine.submit(inputs)
+
+    def infer(self, inputs, timeout=60.0):
+        return self.submit(inputs).result(timeout=timeout)
+
+    def queue_depth(self):
+        """Total queued rows across every replica (the router's
+        pressure signal, same as a single engine's queue_depth)."""
+        return sum(m.engine.queue_depth() for m in self._members)
+
+    # -- health -------------------------------------------------------------
+    def ready(self):
+        """True once EVERY replica's warmup completed — the
+        all-replicas-warm ``/readyz`` contract: a balancer must not
+        route to a fleet any of whose members would pay a compile."""
+        return all(m.engine.ready() for m in self._members)
+
+    def ready_detail(self):
+        return {str(m.index): m.engine.ready() for m in self._members}
+
+    def live(self):
+        """True while ANY replica can serve: a degraded fleet keeps
+        serving through its surviving members (dispatch already excludes
+        the dead ones); all-dead is the restart signal."""
+        return any(m.engine.live() for m in self._members)
+
+    def live_detail(self):
+        return {str(m.index): m.engine.live() for m in self._members}
+
+    def stats(self):
+        """Fleet view: aggregate counters plus the full per-replica
+        stats map (each member's own engine stats, replica-labeled)."""
+        per = {str(m.index): m.engine.stats() for m in self._members}
+        out = {
+            "replicas": len(self._members),
+            "dispatch": "least_queued_rr",
+            "devices": [str(m.device) for m in self._members],
+            "per_replica": per,
+        }
+        for key in ("requests", "rows", "batches", "shed",
+                    "queue_depth", "in_flight"):
+            out[key] = sum(s.get(key, 0) for s in per.values())
+        if self.model:
+            out["model"] = self.model
+        if self.hbm_estimate_bytes is not None:
+            out["hbm_estimate_bytes"] = self.hbm_estimate_bytes
+        out["ready"] = self.ready()
+        return out
+
+    def stop(self, timeout=30.0):
+        """Stop every replica engine (each drains its own queue and
+        closes its own per-replica steplog). Idempotent."""
+        for m in self._members:
+            m.engine.stop(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __repr__(self):
+        return "ReplicaSet(%r, replicas=%d, continuous=%s)" % (
+            self.bundle.name, len(self._members), self.continuous)

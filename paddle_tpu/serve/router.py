@@ -64,8 +64,11 @@ class Router:
         self._lock = threading.Lock()
         self._models = {}
         self._owns_slog = steplog is None
+        # shed records can arrive at flood rate: batch the flush
+        # (crash loses <32 records, not the throughput — steplog.py)
         self._slog = (observe_steplog.from_env(run_name=run_name,
-                                               meta={"phase": "serve"})
+                                               meta={"phase": "serve"},
+                                               flush_every=32)
                       if steplog is None else steplog)
 
     def add_model(self, name, bundle, engine, priority="normal"):

@@ -83,7 +83,7 @@ class ContinuousScheduler:
 
     def __init__(self, bundle, slots=None, steplog=None, warmup=True,
                  run_name="serve", metrics_registry=None, model=None,
-                 max_queue=256):
+                 max_queue=256, replica=None):
         if not bundle.has_decoder():
             raise ValueError(
                 "bundle %r has no decode artifacts; re-export with "
@@ -92,8 +92,14 @@ class ContinuousScheduler:
         self.slots = int(bundle._decode_bucket(slots)["slots"])
         self.window = int(bundle.decode_window)
         self.model = model
+        # ``replica`` marks this scheduler as one member of a replica
+        # fleet (serve/fleet.py): {replica=...} on every metric family
+        # plus an additive ``replica`` field on serve_decode records
+        self.replica = None if replica is None else str(replica)
         self.max_queue = None if max_queue is None else int(max_queue)
         self._labels = {"model": str(model)} if model else {}
+        if self.replica is not None:
+            self._labels["replica"] = self.replica
         self._seq_specs = [s for s in bundle.inputs
                            if s["kind"] in SEQ_KINDS]
         self._out_names = [o["name"] for o in bundle.outputs]
@@ -107,8 +113,11 @@ class ContinuousScheduler:
         self._slots = [_Slot() for _ in range(self.slots)]
         self._carry = None  # device-resident between iterations
         self._owns_slog = steplog is None
+        # serving records arrive at request rate: batch the flush
+        # (crash loses <32 records, not the throughput — steplog.py)
         self._slog = (observe_steplog.from_env(run_name=run_name,
-                                               meta={"phase": "serve"})
+                                               meta={"phase": "serve"},
+                                               flush_every=32)
                       if steplog is None else steplog)
         self.metrics = metrics_registry or observe_metrics.get_registry()
         self._build_metrics()
@@ -121,17 +130,23 @@ class ContinuousScheduler:
                     pass           # the scheduler simply stays not-ready
 
             threading.Thread(target=_bg_warmup,
-                             name="serve-decode-warmup",
+                             name=self._thread_name("serve-decode-warmup"),
                              daemon=True).start()
         elif warmup:
             self._warmup()
         else:
             self._ready.set()
             self._m_ready.set(1)
-        self._worker = threading.Thread(target=self._loop,
-                                        name="serve-decode-worker",
-                                        daemon=True)
+        self._worker = threading.Thread(
+            target=self._loop,
+            name=self._thread_name("serve-decode-worker"), daemon=True)
         self._worker.start()
+
+    def _thread_name(self, base):
+        """Thread names carry the replica index so a fleet's N workers
+        are tellable apart in a stack dump."""
+        return (base if self.replica is None
+                else "%s-r%s" % (base, self.replica))
 
     # the decode step is ONE exported program per (slots, window) pair:
     # after warmup, slot admission/retirement can never mint a shape
@@ -312,6 +327,8 @@ class ContinuousScheduler:
             out["window"] = self.window
         if self.model:
             out["model"] = self.model
+        if self.replica is not None:
+            out["replica"] = self.replica
         out["ready"] = self.ready()
         out["latency_ms"] = self._m_latency.percentiles()
         return out
@@ -438,7 +455,8 @@ class ContinuousScheduler:
                 iteration=self._iter_counter, active=active,
                 window=self.window, slots=self.slots, steps=steps,
                 admitted=len(admitted), retired=len(retired),
-                infer_ms=infer_ms, model=self.model)
+                infer_ms=infer_ms, model=self.model,
+                replica=self.replica)
 
     def _distribute(self, outs, lens):
         """Hand each occupied slot its window of outputs; retire and
@@ -466,6 +484,12 @@ class ContinuousScheduler:
             self._m_in_flight.set(self._in_flight)
             self._stats["requests"] += len(retired)
             self._stats["rows"] += len(retired)
+        # counter updates batched per iteration (one lock round-trip
+        # instead of one per retirement — this loop is on the decode
+        # hot path and its GIL time serializes across fleet replicas);
+        # the latency histograms stay per-sample by definition
+        self._m_requests.inc(len(retired))
+        self._m_rows.inc(len(retired))
         for req in retired:
             result = {
                 name: np.concatenate([c[name] for c in req.collected],
@@ -473,8 +497,6 @@ class ContinuousScheduler:
                 for name in self._out_names}
             queue_ms = (req.t_admit - req.t_enqueue) * 1e3
             latency_ms = (t_done - req.t_enqueue) * 1e3
-            self._m_requests.inc()
-            self._m_rows.inc()
             self._m_queue_ms.observe(queue_ms)
             self._m_latency.observe(latency_ms)
             if self._slog is not None:

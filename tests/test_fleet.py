@@ -1,0 +1,473 @@
+"""Replica-scaled serve fleet tests (docs/serving.md "Replica
+scaling").
+
+Covers the ISSUE 10 acceptance surface:
+
+* **device-keyed params cache**: two placements of one shared Bundle
+  hold two stable cache entries — no re-upload thrash, no
+  wrong-device serving (the regression the single-slot cache had).
+* **least-queued dispatch**: with one replica's device gated, every
+  new submission deterministically lands on the unloaded replica (the
+  PR 8 gated-device pattern).
+* **degraded fleet**: a failed-warmup replica is excluded from
+  dispatch AND keeps the aggregate ``ready()`` (and ``/readyz``) false
+  while the warm replicas keep serving.
+* **static HBM gate**: ``hbm_estimate_bytes x replicas`` vs
+  ``PADDLE_TPU_HBM_BUDGET`` warns at construction, before any
+  device_put.
+* **observability**: ``{replica=}`` labels on the serve metric
+  families, additive ``replica`` field on ``serve_batch``/
+  ``serve_decode`` records (schema-golden), per-replica summary in
+  ``steplog.summarize_dir``.
+* **zero post-warmup compiles** across fleet dispatch churn
+  (``watch_compiles``), and the suite-wide thread-leak gate covers
+  every fleet path by running these tests at all.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "steplog_schema.json")
+
+
+def _mlp_bundle(tmp, name="mnist_mlp"):
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.vision import mlp
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve import load_bundle
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = mlp(hidden=(16, 8))
+    params = Parameters.create(out)
+    bundle_dir = str(tmp / (name + "_bundle"))
+    export_bundle(out, params, bundle_dir, batch_sizes=(1, 4), name=name)
+    return load_bundle(bundle_dir)
+
+
+def _tagger_bundle(tmp):
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.text import sequence_tagging_gru
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve import load_bundle
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = sequence_tagging_gru(dict_size=50, label_size=5, emb_size=8,
+                               hidden=12)
+    params = Parameters.create(out)
+    bundle_dir = str(tmp / "tagger_bundle")
+    export_bundle(out, params, bundle_dir, batch_sizes=(1,), seq_len=32,
+                  name="tagger", decode_slots=(2,), decode_window=4)
+    return load_bundle(bundle_dir)
+
+
+# -- device-keyed params cache -----------------------------------------------
+
+def test_bundle_params_cache_keyed_by_device(tmp_path):
+    """Interleaved placements keep their own stable cache entries: the
+    single-slot cache re-uploaded (or served the wrong device) as soon
+    as two replicas shared a Bundle."""
+    import jax
+
+    bundle = _mlp_bundle(tmp_path)
+    dev = jax.devices()[0]
+    p_default = bundle.params()
+    p_dev = bundle.params(device=dev)
+    # interleave: every call returns the SAME object for its key
+    for _ in range(3):
+        assert bundle.params() is p_default
+        assert bundle.params(device=dev) is p_dev
+    # the pinned entry actually lives on its device
+    leaf = next(iter(p_dev.values()))
+    assert leaf.devices() == {dev}
+
+
+def test_bundle_view_pins_device_and_matches(tmp_path):
+    import jax
+
+    bundle = _mlp_bundle(tmp_path)
+    dev = jax.devices()[0]
+    view = bundle.view(dev)
+    assert view.params() is bundle.params(device=dev)
+    # delegation: manifest surface unchanged
+    assert view.name == bundle.name
+    assert view.batch_sizes() == bundle.batch_sizes()
+    x = {"pixel": np.random.RandomState(0).randn(2, 784)
+         .astype(np.float32)}
+    np.testing.assert_allclose(view.infer(x)["mlp_out"],
+                               bundle.infer(x)["mlp_out"], atol=1e-6)
+
+
+# -- dispatch ----------------------------------------------------------------
+
+def test_fleet_least_queued_dispatch_prefers_short_queue(tmp_path):
+    """Deterministic least-queued routing: once the gated replica holds
+    a queued row, EVERY new submission lands on the unloaded replica
+    (round-robin only breaks ties)."""
+    import time
+
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ReplicaSet
+
+    bundle = _mlp_bundle(tmp_path)
+    fleet = ReplicaSet(bundle, replicas=2,
+                       metrics_registry=MetricsRegistry(),
+                       engine_kwargs={"max_latency_ms": 1.0,
+                                      "max_batch_size": 1},
+                       warmup=True)
+    r0, r1 = fleet.replicas()
+    gate = threading.Event()
+    real_run = r0.bundle.run
+
+    def gated_run(flat, batch):
+        gate.wait(timeout=120)
+        return real_run(flat, batch)
+
+    r0.bundle.run = gated_run  # instance attr on the r0 VIEW only
+    try:
+        x = {"pixel": np.zeros((1, 784), np.float32)}
+        f_a = fleet.submit(dict(x))       # rr -> r0, sticks in its worker
+        f_b = fleet.submit(dict(x))       # r1 (tie or r0 loaded)
+        f_b.result(timeout=60)
+        # wait until A left r0's queue for its (gated) worker...
+        deadline = time.time() + 30
+        while (r0.engine.queue_depth() != 0
+               or r0.engine.stats()["in_flight"] != 1):
+            assert time.time() < deadline
+            time.sleep(0.01)
+        f_c = fleet.submit(dict(x))       # tie again -> rr lands on r0
+        deadline = time.time() + 30
+        while r0.engine.queue_depth() != 1:
+            assert time.time() < deadline
+            time.sleep(0.01)
+        # r0 now has a queued row: the next submissions must ALL pick
+        # r1, and complete while r0 stays gated
+        laters = []
+        for _ in range(3):
+            f = fleet.submit(dict(x))
+            f.result(timeout=60)          # only possible on r1
+            laters.append(f)
+        assert r1.engine.stats()["requests"] == 4  # B + the 3 laters
+        assert not f_a.done() and not f_c.done()
+        gate.set()
+        f_a.result(timeout=60)
+        f_c.result(timeout=60)
+        assert r0.engine.stats()["requests"] == 2
+        assert fleet.stats()["requests"] == 6
+    finally:
+        gate.set()
+        r0.bundle.run = real_run
+        fleet.stop()
+
+
+def test_fleet_failed_warmup_replica_excluded(tmp_path):
+    """A replica whose warmup raised never receives traffic and pins
+    the aggregate readiness at false; the warm replica keeps serving."""
+    import time
+
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ReplicaSet
+
+    bundle = _mlp_bundle(tmp_path)
+    calls = []
+    lock = threading.Lock()
+    real_warmup = bundle.warmup
+
+    def flaky_warmup(device=None):
+        with lock:
+            calls.append(device)
+            turn = len(calls)
+        if turn == 2:
+            raise RuntimeError("corrupt artifact")
+        return real_warmup(device=device)
+
+    bundle.warmup = flaky_warmup
+    try:
+        fleet = ReplicaSet(bundle, replicas=2,
+                           metrics_registry=MetricsRegistry(),
+                           warmup="async")
+        deadline = time.time() + 60
+        while len(calls) < 2 or sum(
+                fleet.ready_detail().values()) < 1:
+            assert time.time() < deadline
+            time.sleep(0.02)
+        time.sleep(0.1)  # let the failed warmup thread unwind
+        detail = fleet.ready_detail()
+        assert sorted(detail.values()) == [False, True]
+        assert fleet.ready() is False       # all-replicas-warm contract
+        assert fleet.live() is True         # degraded but serving
+        # dispatch excludes the cold replica: requests still complete
+        x = {"pixel": np.zeros((1, 784), np.float32)}
+        for _ in range(3):
+            fleet.infer(dict(x), timeout=60)
+        cold = next(m for m in fleet.replicas()
+                    if not m.engine.ready())
+        warm = next(m for m in fleet.replicas() if m.engine.ready())
+        assert cold.engine.stats()["requests"] == 0
+        assert warm.engine.stats()["requests"] == 3
+        fleet.stop()
+    finally:
+        bundle.warmup = real_warmup
+
+
+def test_fleet_no_warm_replica_sheds(tmp_path):
+    """An all-cold fleet sheds with reason no_replica instead of
+    queueing into engines that would pay a compile."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import Overloaded, ReplicaSet
+
+    bundle = _mlp_bundle(tmp_path)
+    gate = threading.Event()
+    real_warmup = bundle.warmup
+
+    def gated_warmup(device=None):
+        gate.wait(timeout=60)
+        return real_warmup(device=device)
+
+    bundle.warmup = gated_warmup
+    reg = MetricsRegistry()
+    try:
+        fleet = ReplicaSet(bundle, replicas=2, metrics_registry=reg,
+                           model="m", warmup="async")
+        with pytest.raises(Overloaded) as exc_info:
+            fleet.submit({"pixel": np.zeros((1, 784), np.float32)})
+        assert exc_info.value.reason == "no_replica"
+        gate.set()
+        fleet.stop()
+        snap = reg.snapshot()["counters"]
+        assert snap['paddle_tpu_serve_shed_total'
+                    '{model="m",reason="no_replica"}'] == 1
+    finally:
+        gate.set()
+        bundle.warmup = real_warmup
+
+
+# -- static HBM gate ---------------------------------------------------------
+
+def test_fleet_hbm_budget_gate(tmp_path, monkeypatch):
+    """N-replica HBM footprint vs PADDLE_TPU_HBM_BUDGET: warns (and
+    records the note) at construction when N copies cannot fit, stays
+    quiet when they can."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ReplicaSet
+
+    bundle = _mlp_bundle(tmp_path)
+    est = bundle.manifest["hbm_estimate_bytes"]
+    assert est > 0
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", str(est * 4))
+    ok = ReplicaSet(bundle, replicas=2,
+                    metrics_registry=MetricsRegistry(), warmup=False)
+    assert ok.hbm_note is None
+    assert ok.hbm_estimate_bytes == est * 2
+    ok.stop()
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", str(est * 2))
+    tight = ReplicaSet(bundle, replicas=3,
+                       metrics_registry=MetricsRegistry(), warmup=False)
+    assert tight.hbm_note is not None
+    assert "PADDLE_TPU_HBM_BUDGET" in tight.hbm_note
+    assert tight.stats()["hbm_estimate_bytes"] == est * 3
+    tight.stop()
+
+
+# -- observability -----------------------------------------------------------
+
+def test_fleet_replica_metrics_and_steplog(tmp_path):
+    """{replica=} labels on the serve families; serve_batch records
+    carry the additive replica field and stay schema-valid; the
+    summarize_dir per-replica view reports them."""
+    from paddle_tpu.observe import steplog
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ReplicaSet
+
+    bundle = _mlp_bundle(tmp_path)
+    reg = MetricsRegistry()
+    slog = steplog.StepLog(str(tmp_path), run_name="fleet",
+                           compile_events=False)
+    fleet = ReplicaSet(bundle, replicas=2, metrics_registry=reg,
+                       model="mlp", steplog=slog,
+                       engine_kwargs={"max_latency_ms": 1.0},
+                       warmup=True)
+    x = {"pixel": np.zeros((1, 784), np.float32)}
+    for _ in range(6):
+        fleet.infer(dict(x), timeout=60)
+    # the engine resolves futures before bumping its counters: poll
+    import time
+
+    deadline = time.time() + 30
+    while fleet.stats()["requests"] != 6 and time.time() < deadline:
+        time.sleep(0.01)
+    stats = fleet.stats()
+    fleet.stop()
+    slog.close()
+    assert stats["requests"] == 6
+    assert set(stats["per_replica"]) == {"0", "1"}
+    # both replicas served (least-queued + rr spreads an idle fleet)
+    assert all(s["requests"] > 0 for s in stats["per_replica"].values())
+    text = reg.to_prometheus()
+    assert 'model="mlp",replica="0"' in text
+    assert 'model="mlp",replica="1"' in text
+    golden = json.load(open(GOLDEN))
+    records = steplog.read_jsonl(slog.path)
+    batches = [r for r in records if r["type"] == "serve_batch"]
+    assert batches
+    spec = golden["record_types"]["serve_batch"]
+    for rec in batches:
+        keys = set(rec)
+        assert set(spec["required"]) <= keys, rec
+        assert not keys - set(spec["required"]) - set(spec["optional"]), rec
+        assert rec["replica"] in ("0", "1")
+    per = steplog._serve_replica_summary(records)
+    assert set(per) == {"0", "1"}
+    assert sum(p["completed"] for p in per.values()) == 6
+
+
+def test_continuous_fleet_decode_replica_field(tmp_path):
+    """A continuous (scheduler) fleet: serve_decode records carry the
+    replica field, dispatch spreads sequences, equivalence holds."""
+    from paddle_tpu.observe import steplog
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ReplicaSet
+
+    bundle = _tagger_bundle(tmp_path)
+    out_name = bundle.outputs[0]["name"]
+    slog = steplog.StepLog(str(tmp_path), run_name="cfleet",
+                           compile_events=False)
+    fleet = ReplicaSet(bundle, replicas=2, continuous=True,
+                       metrics_registry=MetricsRegistry(),
+                       model="tagger", steplog=slog, warmup=True)
+    rng = np.random.RandomState(3)
+    seqs = [rng.randint(0, 50, size=(n,)).astype(np.int32)
+            for n in (5, 2, 7, 3)]
+    futs = [fleet.submit({"word": s}) for s in seqs]
+    results = [f.result(timeout=120) for f in futs]
+    fleet.stop()
+    slog.close()
+    for seq, got in zip(seqs, results):
+        ids = np.zeros((1, bundle.seq_len), np.int32)
+        ids[0, :len(seq)] = seq
+        want = bundle.infer({"word": ids,
+                             "word:lens": np.array([len(seq)],
+                                                   np.int32)})
+        np.testing.assert_allclose(got[out_name],
+                                   want[out_name][0, :len(seq)],
+                                   atol=1e-6)
+    golden = json.load(open(GOLDEN))
+    decodes = [r for r in steplog.read_jsonl(slog.path)
+               if r["type"] == "serve_decode"]
+    assert decodes
+    spec = golden["record_types"]["serve_decode"]
+    for rec in decodes:
+        keys = set(rec)
+        assert set(spec["required"]) <= keys, rec
+        assert not keys - set(spec["required"]) - set(spec["optional"]), rec
+        assert rec["replica"] in ("0", "1")
+
+
+def test_fleet_dispatch_mints_no_compiles(tmp_path):
+    """Zero post-warmup compiles across fleet dispatch churn — the
+    watch_compiles pin of the replica path."""
+    from paddle_tpu.observe import steplog
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ReplicaSet
+
+    bundle = _mlp_bundle(tmp_path)
+    fleet = ReplicaSet(bundle, replicas=2,
+                       metrics_registry=MetricsRegistry(),
+                       engine_kwargs={"max_latency_ms": 1.0},
+                       warmup=True)
+    x = np.random.RandomState(0)
+    with steplog.watch_compiles() as watcher:
+        for rows in (1, 3, 2, 4, 1, 2):
+            fleet.infer({"pixel": x.randn(rows, 784)
+                         .astype(np.float32)}, timeout=60)
+    fleet.stop()
+    assert watcher.compiles == 0, watcher.events
+
+
+# -- front door --------------------------------------------------------------
+
+def test_fleet_behind_router_and_http(tmp_path):
+    """The fleet is duck-typed like an engine: the Router hosts it and
+    the HTTP front door serves /infer, all-replicas-warm /readyz and
+    replica-labeled /metrics unchanged."""
+    import urllib.request
+
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ReplicaSet, Router
+    from paddle_tpu.serve.server import serve_router_in_thread
+
+    bundle = _mlp_bundle(tmp_path)
+    reg = MetricsRegistry()
+    router = Router(metrics_registry=reg)
+    fleet = ReplicaSet(bundle, replicas=2, metrics_registry=reg,
+                       model="mlp",
+                       engine_kwargs={"max_latency_ms": 1.0},
+                       warmup=True)
+    router.add_model("mlp", bundle, fleet)
+    with router:
+        server, _ = serve_router_in_thread(router)
+        base = "http://%s:%d" % server.server_address
+        try:
+            got = json.load(urllib.request.urlopen(base + "/readyz",
+                                                   timeout=30))
+            assert got == {"ready": True, "models": {"mlp": True}}
+            x = np.random.RandomState(1).randn(2, 784)\
+                .astype(np.float32)
+            body = json.dumps({"inputs": {"pixel": x.tolist()}})\
+                .encode()
+            req = urllib.request.Request(
+                base + "/infer/mlp", data=body,
+                headers={"Content-Type": "application/json"})
+            resp = json.load(urllib.request.urlopen(req, timeout=60))
+            want = bundle.infer({"pixel": x})["mlp_out"]
+            np.testing.assert_allclose(
+                np.asarray(resp["outputs"]["mlp_out"], np.float32),
+                want, atol=1e-4)
+            metrics = urllib.request.urlopen(base + "/metrics",
+                                             timeout=30).read().decode()
+            assert 'replica="0"' in metrics
+            stats = json.load(urllib.request.urlopen(base + "/stats",
+                                                     timeout=30))
+            assert stats["models"]["mlp"]["replicas"] == 2
+        finally:
+            server.shutdown()
+
+
+# -- the audited harness (slow) ----------------------------------------------
+
+@pytest.mark.slow
+def test_exp_serve_replicas_ab_gates(tmp_path, monkeypatch):
+    """The audited replicas-ab harness end to end at a tiny scale:
+    equivalence + compile gates asserted before rows emit, rows
+    sanitized + telemetry-mirrored (both fleet and single metrics)."""
+    import glob
+
+    import benchmark.exp_serve as exp_serve
+
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY", str(tmp_path / "telem"))
+    rc = exp_serve.main([
+        "--mode", "replicas-ab", "--replicas", "2", "--requests", "60",
+        "--seed", "7", "--decode-slots", "4", "--decode-window", "4",
+        "--seq-len", "32", "--hidden", "24", "--capacity-passes", "1",
+        "--replicas-min-speedup", "0",  # tiny runs are noise; the full
+    ])                                  # gate run is the bench's job
+    assert rc == 0
+    logs = glob.glob(str(tmp_path / "telem" / "*.steps.jsonl"))
+    assert logs
+    from paddle_tpu.observe import steplog
+
+    rows = [r for p in logs for r in steplog.read_jsonl(p)
+            if r.get("type") == "bench_row"]
+    metrics_seen = {r["metric"] for r in rows}
+    assert "serve_fleet_tagger_qps" in metrics_seen
+    assert "serve_single_tagger_qps" in metrics_seen
+    fleet_row = next(r for r in rows
+                     if r["metric"] == "serve_fleet_tagger_qps")
+    assert fleet_row["replicas"] == 2
+    assert fleet_row["serve_compiles"] == 0

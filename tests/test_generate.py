@@ -1,0 +1,151 @@
+"""Streaming-generation tests (docs/serving.md "Streaming
+generation"): the host-side decode-step loop that feeds y_t back as
+x_{t+1}, plus its ``cli generate`` surface.
+
+The sharpest check is feedback-chain consistency: for the greedy
+continuation, re-running the FULL generated sequence through the
+whole-request batch forward must reproduce every feedback edge —
+``argmax(out[t]) == token[t+1]`` from the last prime position on. A
+drifted carry, an off-by-one window slice or a wrong feedback position
+all break it.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+
+def _lm_bundle(tmp, vocab=16, hidden=12, window=4, seq_len=24):
+    """A next-token-shaped tagger: label space == input vocabulary, so
+    y_t can feed back as x_{t+1}."""
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.text import sequence_tagging_gru
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve import load_bundle
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = sequence_tagging_gru(dict_size=vocab, label_size=vocab,
+                               emb_size=8, hidden=hidden)
+    params = Parameters.create(out)
+    bundle_dir = str(tmp / "lm_bundle")
+    export_bundle(out, params, bundle_dir, batch_sizes=(1,),
+                  seq_len=seq_len, name="lm", decode_slots=(2,),
+                  decode_window=window)
+    return load_bundle(bundle_dir)
+
+
+@pytest.fixture(scope="module")
+def lm_bundle(tmp_path_factory):
+    return _lm_bundle(tmp_path_factory.mktemp("lm_bundle"))
+
+
+def test_generate_greedy_feedback_chain(lm_bundle):
+    from paddle_tpu.serve import generate
+
+    out_name = lm_bundle.outputs[0]["name"]
+    got = generate(lm_bundle, [1, 2, 3], 8)
+    assert got["prime"] == [1, 2, 3]
+    assert len(got["generated"]) == got["steps"] == 8
+    assert all(0 <= t < got["vocab"] for t in got["generated"])
+    # greedy is deterministic
+    assert generate(lm_bundle, [1, 2, 3], 8) == got
+    # feedback-chain consistency vs the whole-request batch forward
+    full = np.array(got["prime"] + got["generated"], np.int32)
+    ids = np.zeros((1, lm_bundle.seq_len), np.int32)
+    ids[0, :len(full)] = full
+    outs = lm_bundle.infer(
+        {"word": ids,
+         "word:lens": np.array([len(full)], np.int32)})[out_name]
+    for i in range(len(got["prime"]) - 1, len(full) - 1):
+        assert int(outs[0, i].argmax()) == int(full[i + 1]), i
+
+
+def test_generate_prime_longer_than_window(lm_bundle):
+    """A prime spanning several decode windows threads its carry
+    across dispatches — the chain check still holds end to end."""
+    from paddle_tpu.serve import generate
+
+    out_name = lm_bundle.outputs[0]["name"]
+    prime = [3, 1, 4, 1, 5, 9, 2, 6, 5]  # 9 tokens, window is 4
+    got = generate(lm_bundle, prime, 5)
+    full = np.array(got["prime"] + got["generated"], np.int32)
+    ids = np.zeros((1, lm_bundle.seq_len), np.int32)
+    ids[0, :len(full)] = full
+    outs = lm_bundle.infer(
+        {"word": ids,
+         "word:lens": np.array([len(full)], np.int32)})[out_name]
+    for i in range(len(prime) - 1, len(full) - 1):
+        assert int(outs[0, i].argmax()) == int(full[i + 1]), i
+
+
+def test_generate_seeded_sampling_reproducible(lm_bundle):
+    from paddle_tpu.serve import generate
+
+    a = generate(lm_bundle, [2, 7], 6, temperature=0.8, seed=42)
+    b = generate(lm_bundle, [2, 7], 6, temperature=0.8, seed=42)
+    c = generate(lm_bundle, [2, 7], 6, temperature=0.8, seed=43)
+    assert a == b
+    assert all(0 <= t < a["vocab"] for t in a["generated"])
+    # different seed: overwhelmingly a different path (not guaranteed
+    # per-token, so only assert the call succeeded with valid ids)
+    assert all(0 <= t < c["vocab"] for t in c["generated"])
+
+
+def test_generate_rejects_non_feedback_head(tmp_path):
+    """A tagging head over a DIFFERENT label space cannot feed back —
+    refused with the reason, not silently modulo'd into the vocab."""
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.text import sequence_tagging_gru
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve import generate, load_bundle
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = sequence_tagging_gru(dict_size=50, label_size=5, emb_size=8,
+                               hidden=12)
+    params = Parameters.create(out)
+    bundle_dir = str(tmp_path / "tagger_bundle")
+    export_bundle(out, params, bundle_dir, batch_sizes=(1,), seq_len=16,
+                  name="tagger", decode_slots=(2,), decode_window=4)
+    bundle = load_bundle(bundle_dir)
+    with pytest.raises(ValueError, match="next-token head"):
+        generate(bundle, [1, 2], 4)
+
+
+def test_generate_input_validation(lm_bundle, tmp_path):
+    from paddle_tpu.serve import generate, load_bundle
+    from paddle_tpu.serve.export import export_bundle
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.vision import mlp
+    from paddle_tpu.parameters import Parameters
+
+    with pytest.raises(ValueError, match="at least one token"):
+        generate(lm_bundle, [], 4)
+    with pytest.raises(ValueError, match="vocab"):
+        generate(lm_bundle, [99], 4)
+    with pytest.raises(ValueError, match="steps"):
+        generate(lm_bundle, [1], -1)
+    # a decoder-less bundle refuses up front
+    reset_name_counters()
+    out = mlp(hidden=(8,))
+    params = Parameters.create(out)
+    d = str(tmp_path / "mlp_bundle")
+    export_bundle(out, params, d, batch_sizes=(1,), name="mlp")
+    with pytest.raises(ValueError, match="decode artifacts"):
+        generate(load_bundle(d), [1], 4)
+
+
+def test_cli_generate_smoke(lm_bundle, capsys):
+    """``cli generate`` end to end in-process: JSON out, greedy
+    deterministic, ids in range."""
+    from paddle_tpu import cli
+
+    rc = cli.main(["generate", lm_bundle.directory,
+                   "--prime", "1,2,3", "--steps", "5"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["prime"] == [1, 2, 3]
+    assert len(out["generated"]) == 5
+    assert all(0 <= t < out["vocab"] for t in out["generated"])
