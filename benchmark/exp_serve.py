@@ -41,10 +41,19 @@ audited set via ``observe/regress.py`` (warn-only by default,
   width (``--replicas-min-speedup`` overrides; the row records both
   the gate used and the core count so the audit sees the derating).
 
+* ``--mode quant-ab`` — the quantized-bundle A/B (docs/serving.md
+  "Quantized bundles"): one set of mlp parameters exported fp AND
+  int8, gated on accuracy (argmax agreement + bounded logit drift),
+  footprint (manifest ``hbm_estimate_bytes`` shrink >= 3x and a
+  bigger replicas-that-fit under a fixed budget) and zero post-warmup
+  compiles; emits qps rows for both sides plus audited ``bytes`` /
+  ``replicas`` capacity rows.
+
 Usage:
   python benchmark/exp_serve.py                       # closed-loop MLP
   python benchmark/exp_serve.py --mode openloop-ab
   python benchmark/exp_serve.py --mode priority
+  python benchmark/exp_serve.py --mode quant-ab
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python benchmark/exp_serve.py --mode replicas-ab --replicas 4
 """
@@ -77,6 +86,24 @@ def _export_demo_bundle(out_dir, batch_sizes):
     return out_dir
 
 
+def _export_quant_pair(fp_dir, q_dir, batch_sizes):
+    """ONE set of mlp parameters exported twice: as the fp bundle and
+    as its int8-quantized twin — the A/B pair of --mode quant-ab."""
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.vision import mlp
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = mlp()
+    params = Parameters.create(out)
+    export_bundle(out, params, fp_dir, batch_sizes=batch_sizes,
+                  name="mnist_mlp")
+    export_bundle(out, params, q_dir, batch_sizes=batch_sizes,
+                  name="mnist_mlp_int8", quantize="int8")
+    return fp_dir, q_dir
+
+
 def _export_tagger_bundle(out_dir, batch_sizes, seq_len, slots, window,
                           hidden, name="tagger"):
     from paddle_tpu.graph import reset_name_counters
@@ -94,13 +121,13 @@ def _export_tagger_bundle(out_dir, batch_sizes, seq_len, slots, window,
     return out_dir
 
 
-def measure(bundle_dir, clients, requests, rows_per_request,
-            max_latency_ms):
-    from paddle_tpu.serve import InferenceEngine, load_bundle
-
-    bundle = load_bundle(bundle_dir)
-    engine = InferenceEngine(bundle, max_latency_ms=max_latency_ms)
-    rng = np.random.RandomState(0)
+def run_closed_loop(engine, bundle, clients, requests, rows_per_request,
+                    rng):
+    """The shared closed-loop client driver: ``clients`` threads each
+    running ``requests // clients`` inferences over 8 pre-built random
+    payloads. Returns ``(latencies_ms ndarray, wall_s)`` — the default
+    mode and quant-ab both drive their engines through this one loop,
+    so the timing convention cannot silently diverge between modes."""
     spec = bundle.inputs[0]
     shape = (rows_per_request,) + tuple(
         bundle.feed_shape(spec, rows_per_request)[1:])
@@ -128,9 +155,20 @@ def measure(bundle_dir, clients, requests, rows_per_request,
     for t in threads:
         t.join()
     wall_s = time.perf_counter() - t_start
+    return np.asarray(latencies), wall_s
+
+
+def measure(bundle_dir, clients, requests, rows_per_request,
+            max_latency_ms):
+    from paddle_tpu.serve import InferenceEngine, load_bundle
+
+    bundle = load_bundle(bundle_dir)
+    engine = InferenceEngine(bundle, max_latency_ms=max_latency_ms)
+    lat, wall_s = run_closed_loop(engine, bundle, clients, requests,
+                                  rows_per_request,
+                                  np.random.RandomState(0))
     stats = engine.stats()
     engine.stop()
-    lat = np.asarray(latencies)
     return {
         "metric": "serve_mlp_qps_c%d" % clients,
         "value": round(len(lat) / wall_s, 2),
@@ -464,6 +502,123 @@ def measure_replicas_ab(args):
     return [row_a, row_b]
 
 
+def measure_quant_ab(args):
+    """The quantized-bundle serving A/B (docs/serving.md "Quantized
+    bundles"): ONE set of mlp parameters exported fp and int8, both
+    served through identical closed-loop engines. Gates asserted BEFORE
+    any row emits: (1) accuracy — argmax agreement >= --quant-min-agree
+    and max logit drift <= --quant-max-drift on a seeded probe batch;
+    (2) footprint — the int8 manifest ``hbm_estimate_bytes`` shrinks
+    >= --quant-min-shrink x vs fp, and under the reference
+    --hbm-budget the int8 bundle fits MORE replicas (serve/fleet
+    .replicas_that_fit); (3) zero post-warmup compiles on either side
+    (``watch_compiles``). The qps delta is recorded, not gated: on a
+    CPU host the dequant multiply costs FLOPs it saves in HBM reads —
+    the bandwidth win is the on-chip rerun's to prove
+    (benchmark/RESULTS.md)."""
+    from paddle_tpu.analyze.topology_check import hbm_budget_bytes
+    from paddle_tpu.observe import steplog as observe_steplog
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine, load_bundle
+    from paddle_tpu.serve.fleet import replicas_that_fit
+
+    # buckets (1, 8), not the closed-loop default (1, 8, 32): the
+    # manifest estimate includes the largest bucket's per-dispatch
+    # feed+activation workspace, which is IDENTICAL on both sides —
+    # a 32-row bucket dilutes the params shrink the capacity chain
+    # (replicas-that-fit) actually banks on
+    # --bundle is ignored here on purpose: the A/B pair must share ONE
+    # set of parameters, so both sides export fresh from the same init
+    fp_dir, q_dir = _export_quant_pair(
+        tempfile.mkdtemp(prefix="serve_quant_fp_"),
+        tempfile.mkdtemp(prefix="serve_quant_int8_"), (1, 8))
+    fp_bundle, q_bundle = load_bundle(fp_dir), load_bundle(q_dir)
+
+    # gate 1: the accuracy gate — fp and int8 must agree on the probe
+    rng = np.random.RandomState(args.seed)
+    rows_max = fp_bundle.max_batch()
+    probe = rng.randn(rows_max, 784).astype(np.float32)
+    out_fp = fp_bundle.infer({"pixel": probe})["mlp_out"]
+    out_q = q_bundle.infer({"pixel": probe})["mlp_out"]
+    agree = float(np.mean(out_fp.argmax(1) == out_q.argmax(1)))
+    drift = float(np.abs(out_fp - out_q).max())
+    assert agree >= args.quant_min_agree, (
+        "quantization accuracy gate FAILED: argmax agreement %.3f < "
+        "%.3f" % (agree, args.quant_min_agree))
+    assert drift <= args.quant_max_drift, (
+        "quantization accuracy gate FAILED: max logit drift %.4f > "
+        "%.4f" % (drift, args.quant_max_drift))
+
+    # gate 2: the capacity chain — smaller manifest estimate, more
+    # replicas under the same budget
+    est_fp = int(fp_bundle.manifest["hbm_estimate_bytes"])
+    est_q = int(q_bundle.manifest["hbm_estimate_bytes"])
+    shrink = est_fp / est_q
+    assert shrink >= args.quant_min_shrink, (
+        "quantization footprint gate FAILED: hbm_estimate_bytes "
+        "shrank %.2fx (%d -> %d), need >= %.1fx"
+        % (shrink, est_fp, est_q, args.quant_min_shrink))
+    budget = hbm_budget_bytes(env=args.hbm_budget)
+    if budget is None:
+        raise SystemExit(
+            "--hbm-budget %r did not parse (want PADDLE_TPU_HBM_BUDGET "
+            "syntax, e.g. 4M / 16G / plain bytes)" % args.hbm_budget)
+    fit_fp = replicas_that_fit(fp_bundle, budget)
+    fit_q = replicas_that_fit(q_bundle, budget)
+    assert fit_q > fit_fp, (
+        "quantization capacity gate FAILED: int8 fits %d replicas vs "
+        "fp %d under budget %s" % (fit_q, fit_fp, args.hbm_budget))
+
+    def closed_loop(bundle):
+        """Closed-loop qps/latency on one side through the shared
+        driver, with the post-warmup compile gate (the replicas-ab
+        convention)."""
+        engine = InferenceEngine(bundle,
+                                 max_latency_ms=args.max_latency_ms,
+                                 metrics_registry=MetricsRegistry(),
+                                 warmup=True)
+        with observe_steplog.watch_compiles() as watch:
+            lat, wall_s = run_closed_loop(engine, bundle, args.clients,
+                                          args.requests,
+                                          args.rows_per_request, rng)
+        engine.stop()
+        # gate 3: a warm quantized engine must serve exactly like a
+        # warm fp engine — zero compiles in the measured phase
+        assert watch.compiles == 0, (
+            "quant-ab %s side minted %d post-warmup compiles: %s"
+            % (bundle.name, watch.compiles, watch.events))
+        p50, p99 = _percentiles(lat)
+        return len(lat) / wall_s, p50, p99, wall_s
+
+    qps_fp, p50_fp, p99_fp, wall_fp = closed_loop(fp_bundle)
+    qps_q, p50_q, p99_q, wall_q = closed_loop(q_bundle)
+
+    base = {
+        "unit": "qps", "requests": args.requests,
+        "clients": args.clients,
+        "rows_per_request": args.rows_per_request, "seed": args.seed,
+    }
+    row_fp = dict(base, metric="serve_quant_fp_qps",
+                  value=round(qps_fp, 2), p50_ms=p50_fp, p99_ms=p99_fp,
+                  wall_s=round(wall_fp, 3), mode="fp32")
+    row_q = dict(base, metric="serve_quant_int8_qps",
+                 value=round(qps_q, 2), p50_ms=p50_q, p99_ms=p99_q,
+                 wall_s=round(wall_q, 3), mode="int8",
+                 speedup_vs_fp=round(qps_q / qps_fp, 2),
+                 argmax_agreement=round(agree, 4),
+                 max_logit_drift=round(drift, 5),
+                 serve_compiles=0)
+    row_hbm = {"metric": "serve_quant_hbm_int8_bytes", "value": est_q,
+               "unit": "bytes", "fp_bytes": est_fp,
+               "shrink_vs_fp": round(shrink, 2),
+               "scheme": q_bundle.quantization["scheme"]}
+    row_fit = {"metric": "serve_quant_replicas_fit", "value": fit_q,
+               "unit": "replicas", "fp_fit": fit_fp,
+               "budget": args.hbm_budget,
+               "delta_vs_fp": fit_q - fit_fp}
+    return [row_fp, row_q, row_hbm, row_fit]
+
+
 def measure_priority(args):
     """The mixed two-model shed run: high-priority MLP at a sustainable
     rate, low-priority MLP flooded, one Router. Only low may shed; the
@@ -594,7 +749,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", default="closed",
                     choices=("closed", "openloop-ab", "priority",
-                             "replicas-ab"))
+                             "replicas-ab", "quant-ab"))
     ap.add_argument("--bundle", default="",
                     help="pre-exported bundle dir (default: export the "
                          "mode's demo bundle to a tmp dir)")
@@ -646,6 +801,21 @@ def main(argv=None):
                          "noise only ever slows a pass)")
     ap.add_argument("--p99-tol-pct", type=float, default=50.0,
                     help="priority gate: high p99 under flood vs solo")
+    ap.add_argument("--quant-min-agree", type=float, default=0.98,
+                    help="quant-ab accuracy gate: minimum argmax "
+                         "agreement between the fp and int8 bundles "
+                         "on the seeded probe batch")
+    ap.add_argument("--quant-max-drift", type=float, default=0.05,
+                    help="quant-ab accuracy gate: maximum absolute "
+                         "output drift (softmax scale) fp vs int8")
+    ap.add_argument("--quant-min-shrink", type=float, default=3.0,
+                    help="quant-ab footprint gate: the int8 manifest "
+                         "hbm_estimate_bytes must shrink >= this x "
+                         "vs the fp bundle")
+    ap.add_argument("--hbm-budget", default="4M",
+                    help="quant-ab: the reference device-memory budget "
+                         "for the replicas-that-fit delta row "
+                         "(PADDLE_TPU_HBM_BUDGET syntax)")
     args = ap.parse_args(argv)
 
     from benchmark.harness import enable_compile_cache
@@ -657,6 +827,8 @@ def main(argv=None):
         return _emit(measure_priority(args), "exp_serve_priority")
     if args.mode == "replicas-ab":
         return _emit(measure_replicas_ab(args), "exp_serve_replicas")
+    if args.mode == "quant-ab":
+        return _emit(measure_quant_ab(args), "exp_serve_quant")
     bundle_dir = args.bundle
     if not bundle_dir:
         bundle_dir = _export_demo_bundle(

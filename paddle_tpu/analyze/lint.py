@@ -102,6 +102,10 @@ HOT_PATHS = {
                            "_distribute", "_admit"},
     "serve/router.py": {"submit", "total_queued"},
     "serve/fleet.py": {"submit", "queue_depth", "_eligible"},
+    # the quantized-bundle dequant hook is traced INTO every exported
+    # program (serve/export.py), so a stray host sync in it would land
+    # on every serving dispatch of every quantized bundle
+    "serve/quantize.py": {"dequant_for_trace", "dequantize"},
     "data/feeder.py": {"_produce", "batches", "chunks"},
     # per-step dispatch paths that predate PTA001: the cluster worker's
     # whole train loop and the mesh strategy's per-step wrappers

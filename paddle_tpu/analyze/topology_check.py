@@ -400,15 +400,21 @@ def _optimizer_slot_factor(optimizer):
 
 
 def estimate_hbm_bytes(topology, rows=None, seq_pad=None, parameters=None,
-                       optimizer=None, mode="train", steps=1):
+                       optimizer=None, mode="train", steps=1,
+                       param_dtypes=None):
     """Static HBM footprint of one compiled program, from the
     topology's shape math alone — no tracing, no device.
 
     Components (all bytes):
 
     * ``params`` — every parameter buffer (trainable masters + static +
-      running state), exact when a :class:`Parameters` object is passed,
-      shape-derived (f32) otherwise;
+      running state). Exact when a :class:`Parameters` object is passed
+      (per-buffer live ``nbytes``, so a mixed-dtype payload — e.g. a
+      quantized bundle's int8 weights + f32 scale sidecars next to fp
+      biases — counts each tensor at its real width); shape-derived
+      otherwise, at ``param_dtypes.get(name, "float32")`` per
+      parameter (the one-dtype-fits-all f32 assumption is only the
+      default now, not baked in);
     * ``replica`` — the bf16 read replica of the trainable carry when a
       sub-f32 compute dtype is active (mode="train" only);
     * ``opt_slots`` — optimizer slot state, probed from the optimizer's
@@ -439,9 +445,17 @@ def estimate_hbm_bytes(topology, rows=None, seq_pad=None, parameters=None,
         trainable_bytes = sum(name_bytes[n] for n in trainable_names)
     else:
         specs = topology.param_specs()
-        sizes = {n: int(np.prod(s.shape) or 1) * 4
+        dtypes = param_dtypes or {}
+        sizes = {n: int(np.prod(s.shape) or 1)
+                 * np.dtype(dtypes.get(n, "float32")).itemsize
                  for n, s in specs.items()}
         params_bytes = sum(sizes.values())
+        # per-channel scale sidecars of int8-quantized tensors (one f32
+        # per output channel, serve/quantize.py) ride with their tensor
+        params_bytes += sum(
+            int(specs[n].shape[-1]) * 4 for n in sizes
+            if np.dtype(dtypes.get(n, "float32")) == np.int8
+            and len(specs[n].shape) >= 1)
         # trainable = not running state AND not frozen (is_static), the
         # same split Parameters.partition() makes on the exact path
         trainable_bytes = sum(

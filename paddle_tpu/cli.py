@@ -324,7 +324,8 @@ def cmd_export(args):
                                         if args.platforms else None),
                              decode_slots=decode_slots,
                              decode_window=getattr(args, "decode_window",
-                                                   None))
+                                                   None),
+                             quantize=getattr(args, "quantize", "") or None)
     import jax
 
     if jax.default_backend() in manifest["platforms"]:
@@ -336,7 +337,10 @@ def cmd_export(args):
                "name": manifest["name"],
                "buckets": [b["batch"] for b in manifest["buckets"]],
                "inputs": [i["name"] for i in manifest["inputs"]],
-               "platforms": manifest["platforms"]}
+               "platforms": manifest["platforms"],
+               "hbm_estimate_bytes": manifest["hbm_estimate_bytes"]}
+    if manifest.get("quantization"):
+        summary["quantization"] = manifest["quantization"]["scheme"]
     if manifest.get("decode"):
         summary["decode_slots"] = [b["slots"] for b in
                                    manifest["decode"]["slots"]]
@@ -345,7 +349,8 @@ def cmd_export(args):
     return 0
 
 
-def _make_engine(bundle, args, reg, model=None, warmup="async"):
+def _make_engine(bundle, args, reg, model=None, warmup="async",
+                 budget_share=None):
     from paddle_tpu.serve import ContinuousScheduler, InferenceEngine
 
     if args.continuous and not bundle.has_decoder():
@@ -361,12 +366,17 @@ def _make_engine(bundle, args, reg, model=None, warmup="async"):
         # replica scaling (docs/serving.md "Replica scaling"): ONE
         # bundle onto N devices as N shared-nothing engines behind a
         # least-queued dispatch front, duck-typed like a single engine
-        import jax
-
         from paddle_tpu.serve import ReplicaSet
+        from paddle_tpu.serve.fleet import auto_replicas
 
-        n = (len(jax.devices()) if replicas == "auto"
-             else int(replicas))
+        # "auto" sizes the fleet from the HARDWARE (one per device) or,
+        # under PADDLE_TPU_HBM_BUDGET, from the bundle's manifest HBM
+        # estimate — a quantized bundle's smaller estimate admits more
+        # replicas for the same budget (serve/fleet.py). A multi-model
+        # host passes each model its SHARE of the budget so N auto
+        # fleets cannot jointly overcommit the chip.
+        n = (auto_replicas(bundle, budget=budget_share)
+             if replicas == "auto" else int(replicas))
         kwargs = ({"max_queue": args.max_queue_rows} if args.continuous
                   else {"max_batch_size": args.max_batch_size,
                         "max_latency_ms": args.max_latency_ms,
@@ -408,6 +418,15 @@ def cmd_serve(args):
 
         reg = observe_metrics.get_registry()
         router = Router(metrics_registry=reg)
+        # N hosted models split one device-memory budget: each auto
+        # fleet sizes against its share, not the whole budget
+        budget_share = None
+        if args.replicas == "auto" and len(args.model) > 1:
+            from paddle_tpu.analyze.topology_check import hbm_budget_bytes
+
+            budget = hbm_budget_bytes()
+            if budget is not None:
+                budget_share = budget // len(args.model)
         for spec in args.model:
             name, _, rest = spec.partition("=")
             if not rest:
@@ -419,7 +438,8 @@ def cmd_serve(args):
                 directory, priority = rest, "normal"
             bundle = load_bundle(directory)
             router.add_model(name, bundle,
-                             _make_engine(bundle, args, reg, model=name),
+                             _make_engine(bundle, args, reg, model=name,
+                                          budget_share=budget_share),
                              priority=priority or "normal")
         server = make_router_server(router, host=args.host,
                                     port=args.port)
@@ -802,6 +822,13 @@ def main(argv=None):
                         "(streamable recurrent topologies only)")
     p.add_argument("--decode-window", type=int, default=None,
                    help="decode timesteps per dispatch (default 8)")
+    p.add_argument("--quantize", default="", choices=("", "int8"),
+                   help="weight-only quantization: int8 stores matmul/"
+                        "conv weights per-output-channel symmetric int8 "
+                        "with f32 scale sidecars (biases/norm/embedding "
+                        "tables stay fp; dequant fuses into the exported "
+                        "dot) — ~4x smaller bundle, proportionally more "
+                        "--replicas auto under PADDLE_TPU_HBM_BUDGET")
     p.set_defaults(fn=cmd_export)
 
     p = sub.add_parser("generate")
@@ -840,8 +867,11 @@ def main(argv=None):
                    help="N|auto: load each bundle onto N devices as N "
                         "shared-nothing engine replicas behind one "
                         "least-queued dispatch front (auto = one per "
-                        "visible device); /metrics gains {replica=} "
-                        "labels, /readyz is all-replicas-warm")
+                        "visible device, or — under PADDLE_TPU_HBM_"
+                        "BUDGET — as many as the bundle's manifest HBM "
+                        "estimate fits, so quantized bundles admit "
+                        "more); /metrics gains {replica=} labels, "
+                        "/readyz is all-replicas-warm")
     p.add_argument("--selfcheck", action="store_true",
                    help="load, warm, run one batch, exit (smoke gate)")
     p.add_argument("--host", default="127.0.0.1")

@@ -80,6 +80,13 @@ class SparseRows:
                 w.shape[0], self.dim)
         safe = jnp.maximum(self.ids, 0)
         rows = jnp.take(w, safe, axis=0)          # [B, K, size]
+        if rows.dtype == jnp.int8:
+            # quantized weight (serve/quantize.py): dequantize AFTER
+            # the gather so only the [B, K, size] slice converts and
+            # the HBM-resident table stays int8 — the caller applies
+            # the per-output-channel scale to the result (it commutes
+            # past the row K-sum)
+            rows = rows.astype(jnp.float32)
         wts = self.weights().astype(rows.dtype)
         return jnp.sum(rows * wts[..., None], axis=1)
 

@@ -52,6 +52,22 @@ def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
             w = params[spec.name]
             from paddle_tpu.core.sparse import SparseRows
 
+            if w.dtype == jnp.int8:
+                # quantized serving bundle (serve/quantize.py): the
+                # weight rides as per-output-channel int8 with an f32
+                # scale sidecar; the dequant-fused dot keeps the
+                # HBM-resident tensor int8
+                from paddle_tpu.ops.pallas_kernels import int8_matmul
+                from paddle_tpu.serve.quantize import scale_name
+
+                scale = params[scale_name(spec.name)]
+                if isinstance(value, SparseRows):
+                    # the gather dequantizes only the picked K rows
+                    # (core/sparse.py); the per-output-channel scale
+                    # commutes past the row contraction
+                    return value.matmul(w) * scale
+                return featurewise(
+                    lambda d: int8_matmul(d, w, scale), value)
             if isinstance(value, SparseRows):
                 # sparse fast path: row gather + weighted K-sum — the
                 # reference's sparse FC (SparseRowMatrix mul) without

@@ -38,10 +38,14 @@ GATE_ENV = "PADDLE_TPU_BENCH_GATE"
 
 # units where a SMALLER value is better; everything rate-like is
 # bigger-better. Metrics whose direction cannot be determined are not
-# gated (status "ungated").
-_LOWER_BETTER_UNITS = ("ms/batch", "ms/step", "ms", "s", "pct_waste")
+# gated (status "ungated"). "bytes" gates footprint rows (a quantized
+# bundle's manifest hbm_estimate_bytes — growing back toward f32 is a
+# regression); "replicas" gates capacity rows (replicas-that-fit under
+# a fixed budget — fewer fitting is a regression).
+_LOWER_BETTER_UNITS = ("ms/batch", "ms/step", "ms", "s", "pct_waste",
+                       "bytes")
 _HIGHER_BETTER_UNITS = ("samples/s", "qps", "MB/s", "checks_passed",
-                        "checks")
+                        "checks", "replicas")
 
 
 def direction(row):

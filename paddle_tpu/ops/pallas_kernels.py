@@ -43,6 +43,8 @@ path. CPU tests run the same kernels with interpret=True.
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.utils import flags as _flags
+
 try:  # pallas import registers TPU lowerings; in stripped CPU test envs
     # (axon-patched jax without the tpu plugin) it raises — gate on it
     from jax.experimental import pallas as pl
@@ -839,3 +841,119 @@ def _gru_vjp_bwd(res, cotangents):
 
 
 gru_fused.defvjp(_gru_vjp_fwd, _gru_vjp_bwd)
+
+
+# ======================================================================
+# int8 dequant matmul (quantized serving bundles, serve/quantize.py)
+# ======================================================================
+#
+# The serving-side counterpart of the conv kernels' lane packing: a
+# quantized bundle stores matmul weights as per-output-channel int8
+# (+ f32 scale sidecar), and the weight read IS the bandwidth cost of
+# a serving dot. The default path below lets XLA fuse the dequant
+# multiply into the dot (the int8 tensor is what streams from HBM);
+# this kernel is the hand-fused alternative — the int8 column block
+# and its scale slice live in VMEM, dequant runs in-register against
+# the streamed activations — gated exactly like ops/pallas_conv.py:
+# "auto" fires only for (K, N) shapes with a recorded on-chip A/B win.
+
+# (k, n) weight shapes where benchmark/exp_serve.py --mode quant-ab
+# recorded a device-timed win for the Pallas int8 dot over the XLA
+# dequant-fused dot. M (the batch/rows axis) is excluded: the grid is
+# per column block, so per-step work is M-invariant the same way the
+# conv gate is batch-invariant. Ships empty until the first real-chip
+# measurement lands (default-safe: the XLA path is untouched). Record
+# wins with the measured ms in a comment, e.g. (784, 128): 0.08 vs
+# 0.11 XLA.
+_INT8_MEASURED_WINS = frozenset()
+
+_flags.define_flag("int8_matmul", "auto",
+                   "Pallas int8-dot dispatch for quantized-bundle "
+                   "matmuls: auto (only (K, N) shapes with a recorded "
+                   "A/B win — see ops/pallas_kernels.py "
+                   "_INT8_MEASURED_WINS), on (all supported shapes), "
+                   "off (trace-time flag; env PADDLE_TPU_INT8_MATMUL)")
+
+
+def int8_matmul_mode(m, k, n, dtype):
+    """'blocked' when the Pallas int8 dot can lower for this shape,
+    else None (XLA dequant-fused fallback). The grid is one 128-wide
+    output-column block per step; the full [M, K] activation block and
+    the [K, 128] int8 weight block must fit VMEM together."""
+    if n < _BLK or n % _BLK != 0:
+        return None
+    if _INTERPRET:  # CPU interpret tests: no VMEM/lane constraints
+        return "blocked"
+    if k < 8 or k % 8 != 0 or m < 1:
+        return None
+    isz = _itemsize(dtype)
+    working = (m * k * isz          # activation block (fixed index)
+               + 2 * k * _BLK       # int8 weight block, dbl-buffered
+               + 2 * _BLK * 4       # scale slice
+               + 2 * m * _BLK * isz)  # out block
+    return "blocked" if working <= _VMEM_BUDGET else None
+
+
+def _int8_matmul_take_kernel(m, k, n, dtype):
+    if not enabled():
+        return False
+    mode = _flags.get_flag("int8_matmul")
+    if mode == "off" or int8_matmul_mode(m, k, n, dtype) is None:
+        return False
+    if mode == "on":
+        return True
+    return (k, n) in _INT8_MEASURED_WINS
+
+
+def _int8_matmul_kernel(x_ref, w_ref, s_ref, o_ref):
+    dt = x_ref.dtype
+    # dequant in VMEM: the HBM-resident weight is int8; one broadcast
+    # multiply against the per-output-channel scale feeds the MXU dot
+    w = (w_ref[:].astype(jnp.float32) * s_ref[:]).astype(dt)
+    o_ref[:] = jnp.dot(x_ref[:], w,
+                       preferred_element_type=jnp.float32,
+                       precision=_dot_precision(dt)).astype(o_ref.dtype)
+
+
+def _int8_matmul_call(x, w_q, scale):
+    m, k = x.shape
+    n = w_q.shape[-1]
+    nj = n // _BLK
+    return pl.pallas_call(
+        _int8_matmul_kernel,
+        grid=(nj,),
+        in_specs=[
+            pl.BlockSpec((m, k), lambda j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, _BLK), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BLK), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((m, _BLK), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((m, n), x.dtype)],
+        interpret=_interpret(),
+    )(x, w_q, scale.reshape(1, n))[0]
+
+
+def int8_matmul(x, w_q, scale):
+    """``x @ dequant(w_q, scale)`` for a per-output-channel int8 weight
+    (serve/quantize.py): the quantized-bundle matmul. ``x`` is [..., K]
+    floating, ``w_q`` [K, N] int8, ``scale`` [N] f32. Default path is
+    the XLA dequant-fused dot — the multiply sits inside the jit
+    program, so the weight streams from HBM as int8 either way; the
+    Pallas kernel takes over only for shapes behind the
+    ``_INT8_MEASURED_WINS`` gate (or PADDLE_TPU_INT8_MATMUL=on)."""
+    k = x.shape[-1]
+    n = w_q.shape[-1]
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= int(d)
+    if _int8_matmul_take_kernel(m, k, n, x.dtype):
+        out = _int8_matmul_call(x.reshape((m, k)), w_q, scale)
+        return out.reshape(lead + (n,))
+    return jnp.matmul(x, w_q.astype(x.dtype) * scale.astype(x.dtype))

@@ -75,6 +75,11 @@ class Bundle:
         self.inputs = self.manifest["inputs"]
         self.outputs = self.manifest["outputs"]
         self.seq_len = self.manifest.get("seq_len")
+        # quantized-bundle metadata (export --quantize, serve/quantize
+        # .py): purely descriptive at load time — the dequant math is
+        # baked into the exported programs, so the load side stays
+        # deserialization-only; None for fp bundles
+        self.quantization = self.manifest.get("quantization")
         # buckets sorted ascending so bucket_for takes the first fit
         self.buckets = sorted(self.manifest["buckets"],
                               key=lambda b: b["batch"])
@@ -390,9 +395,11 @@ class Bundle:
         return BundleReplica(self, device)
 
     def __repr__(self):
-        return "Bundle(%r, buckets=%s, inputs=%s)" % (
+        quant = (", quantized=%s" % self.quantization["scheme"]
+                 if self.quantization else "")
+        return "Bundle(%r, buckets=%s, inputs=%s%s)" % (
             self.name, self.batch_sizes(),
-            [i["name"] for i in self.inputs])
+            [i["name"] for i in self.inputs], quant)
 
 
 class BundleReplica:

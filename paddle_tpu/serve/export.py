@@ -90,14 +90,21 @@ def _input_specs(topology):
     return specs
 
 
-def _make_forward(topology, specs, out_names):
+def _make_forward(topology, specs, out_names, quantization=None):
     """The function that gets AOT-lowered: (params, flat_inputs) ->
     {output_name: array}. Rebuilds SequenceBatch values from the flat
     ids+lengths pairs at trace time; test-mode forward (dropout off, BN
-    moving stats from params)."""
+    moving stats from params). With ``quantization`` (the manifest
+    block from serve/quantize.py) the int8 weight payload dequantizes
+    INSIDE the traced program — non-native entries here, native ones in
+    their consuming layer — so XLA fuses ``w_int8 * scale`` into the
+    dot and the HBM-resident weights stay int8."""
     from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.serve.quantize import dequant_for_trace
 
     def forward(params, flat):
+        if quantization is not None:
+            params = dequant_for_trace(params, quantization)
         feed = {}
         for spec in specs:
             if spec.kind in ("seq_index", "seq_dense"):
@@ -144,7 +151,7 @@ def _check_streamable(topology, specs):
             "per-timestep slice to stream", spec.kind, spec.name)
 
 
-def _make_decode_step(topology, specs, out_names):
+def _make_decode_step(topology, specs, out_names, quantization=None):
     """The continuous-batching decode step that gets AOT-lowered once
     per slot capacity: ``(params, carry, flat) -> (carry', outputs)``
     over a fixed ``[slots, window]`` matrix.
@@ -158,8 +165,11 @@ def _make_decode_step(topology, specs, out_names):
     the cells run). ``carry`` is ``{recurrent_layer_name: [leaf, ...]}``
     with leading dim ``slots`` on every leaf."""
     from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.serve.quantize import dequant_for_trace
 
     def step(params, carry, flat):
+        if quantization is not None:
+            params = dequant_for_trace(params, quantization)
         reset = flat["reset"]
         lens = flat["lens"]
         keep = 1.0 - reset
@@ -188,7 +198,7 @@ def _make_decode_step(topology, specs, out_names):
 def export_bundle(output_layer, parameters, out_dir,
                   batch_sizes=DEFAULT_BATCH_SIZES, seq_len=None,
                   name=None, platforms=None, decode_slots=None,
-                  decode_window=None):
+                  decode_window=None, quantize=None):
     """AOT-export the inference forward over ``output_layer`` as a
     versioned bundle directory; returns the manifest dict.
 
@@ -208,6 +218,15 @@ def export_bundle(output_layer, parameters, out_dir,
     Requires a streamable topology (per-position layers + forward
     recurrent layers; checked). ``decode_window`` is the timesteps per
     dispatch (default ``DEFAULT_DECODE_WINDOW`` = 8).
+
+    ``quantize="int8"`` writes a **quantized bundle** (docs/serving.md
+    "Quantized bundles"): matmul/conv weights become per-output-channel
+    symmetric int8 with f32 scale sidecars in ``params.npz`` (biases,
+    norm/embedding tables and recurrent cells stay fp; decode carries
+    untouched), the exported programs dequantize inside the jit so HBM
+    weight traffic drops ~4x, the manifest records the ``quantization``
+    block, and ``hbm_estimate_bytes`` shrinks accordingly — which
+    raises ``cli serve --replicas auto`` under PADDLE_TPU_HBM_BUDGET.
     """
     import jax
     from jax import export as jax_export
@@ -230,10 +249,23 @@ def export_bundle(output_layer, parameters, out_dir,
     else:
         seq_len = None
 
+    quantization = None
+    if quantize:
+        enforce(quantize == "int8",
+                "unsupported quantize scheme %r (only 'int8')", quantize)
+        from paddle_tpu.serve.quantize import quantize_parameters
+
+        # the quantized Parameters REPLACE the fp payload from here on:
+        # the npz, the exported call signatures and the HBM estimate
+        # all see the int8 tensors + scale sidecars
+        parameters, quantization = quantize_parameters(parameters,
+                                                       topology)
+
     params = {k: np.asarray(parameters.get(k)) for k in parameters.names()}
     param_structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                      for k, v in params.items()}
-    forward = _make_forward(topology, specs, out_names)
+    forward = _make_forward(topology, specs, out_names,
+                            quantization=quantization)
     jitted = jax.jit(forward)
     export_kwargs = {}
     if platforms is not None:
@@ -273,7 +305,8 @@ def export_bundle(output_layer, parameters, out_dir,
         _check_streamable(topology, specs)
         window = int(decode_window or DEFAULT_DECODE_WINDOW)
         enforce(window >= 1, "decode_window must be >= 1, got %r", window)
-        step = _make_decode_step(topology, specs, out_names)
+        step = _make_decode_step(topology, specs, out_names,
+                                 quantization=quantization)
         slot_sizes = sorted({int(s) for s in decode_slots})
         enforce(slot_sizes[0] >= 1,
                 "decode_slots must be positive, got %r", decode_slots)
@@ -292,7 +325,10 @@ def export_bundle(output_layer, parameters, out_dir,
 
             def probe(params, flat, _specs=specs):
                 from paddle_tpu.core.sequence import SequenceBatch
+                from paddle_tpu.serve.quantize import dequant_for_trace
 
+                if quantization is not None:
+                    params = dequant_for_trace(params, quantization)
                 feed = {s.name: SequenceBatch(flat[s.name], flat["lens"])
                         for s in _specs}
                 _, st = topology.apply_decode(params, feed, {})
@@ -381,6 +417,8 @@ def export_bundle(output_layer, parameters, out_dir,
         "params_file": params_file,
         "hbm_estimate_bytes": int(hbm_est["total"]),
     }
+    if quantization is not None:
+        manifest["quantization"] = quantization
     if decode_manifest is not None:
         manifest["decode"] = decode_manifest
     with open(os.path.join(out_dir, MANIFEST_NAME), "w") as fh:

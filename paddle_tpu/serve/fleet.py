@@ -68,6 +68,52 @@ class Replica:
         return "Replica(%d, device=%s)" % (self.index, self.device)
 
 
+def replicas_that_fit(bundle, budget=None):
+    """How many parameter copies of this bundle the HBM budget holds:
+    ``budget // hbm_estimate_bytes`` (the manifest's export-time static
+    estimate). None when no budget or no estimate exists; 0 means even
+    one copy does not fit. This is the capacity number quantized
+    bundles move: an int8 export shrinks the estimate ~4x, so the same
+    budget fits ~4x the replicas (docs/serving.md "Quantized
+    bundles")."""
+    est = bundle.manifest.get("hbm_estimate_bytes")
+    if budget is None:
+        from paddle_tpu.analyze.topology_check import hbm_budget_bytes
+
+        budget = hbm_budget_bytes()
+    if not est or budget is None:
+        return None
+    return int(budget // int(est))
+
+
+# ``--replicas auto`` never spawns more engine threads than this, no
+# matter how small the bundle: past ~a few engines per core the GIL is
+# the wall, not HBM (pin an explicit --replicas N to go beyond)
+_AUTO_REPLICA_CAP = 64
+
+
+def auto_replicas(bundle, devices=None, budget=None):
+    """The ``cli serve --replicas auto`` width: one replica per visible
+    device, made BUDGET-AWARE when ``PADDLE_TPU_HBM_BUDGET`` is set —
+    as many replicas as :func:`replicas_that_fit` admits (replicas
+    cycle over devices, so the count may exceed the device count on
+    purpose: extra same-device engines overlap host-side work), capped
+    at ``_AUTO_REPLICA_CAP``, floored at 1 (the 1-replica fleet then
+    still warns through :func:`fleet_hbm_check`). ``budget`` overrides
+    the environment budget — a multi-model host passes each model its
+    SHARE of the budget, so N auto fleets never overcommit the chip
+    N-fold (paddle_tpu.cli cmd_serve)."""
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    n_dev = len(list(devices))
+    fit = replicas_that_fit(bundle, budget)
+    if fit is None:
+        return max(n_dev, 1)
+    return max(1, min(fit, _AUTO_REPLICA_CAP))
+
+
 def fleet_hbm_check(bundle, replicas):
     """Static HBM gate for an N-replica load: the manifest's export-time
     ``hbm_estimate_bytes`` times ``replicas`` against

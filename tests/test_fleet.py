@@ -275,6 +275,42 @@ def test_fleet_hbm_budget_gate(tmp_path, monkeypatch):
     tight.stop()
 
 
+def test_replicas_that_fit_and_budget_aware_auto(tmp_path, monkeypatch):
+    """``--replicas auto`` sizing (serve/fleet.py): one per device with
+    no budget; budget // manifest estimate (capped, floored at 1) when
+    PADDLE_TPU_HBM_BUDGET is set — the knob a quantized bundle's
+    smaller estimate turns into more replicas."""
+    from paddle_tpu.serve.fleet import (_AUTO_REPLICA_CAP, auto_replicas,
+                                        replicas_that_fit)
+
+    bundle = _mlp_bundle(tmp_path)
+    est = bundle.manifest["hbm_estimate_bytes"]
+    monkeypatch.delenv("PADDLE_TPU_HBM_BUDGET", raising=False)
+    assert replicas_that_fit(bundle) is None  # no budget -> no opinion
+    assert auto_replicas(bundle, devices=[None, None]) == 2
+
+    assert replicas_that_fit(bundle, est * 5) == 5
+    assert replicas_that_fit(bundle, est - 1) == 0  # not even one copy
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", str(est * 5))
+    assert replicas_that_fit(bundle) == 5
+    # budget-aware auto may exceed the device count (replicas cycle)
+    assert auto_replicas(bundle, devices=[None]) == 5
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", str(est - 1))
+    assert auto_replicas(bundle, devices=[None]) == 1  # floored; warns
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", str(est * 10 ** 6))
+    assert auto_replicas(bundle, devices=[None]) == _AUTO_REPLICA_CAP
+    # an explicit budget overrides the env: the multi-model host hands
+    # each model its SHARE so N auto fleets cannot jointly overcommit
+    assert auto_replicas(bundle, devices=[None], budget=est * 3) == 3
+
+    # a manifest without the estimate (pre-PR-9 bundle): device count
+    class _Legacy:
+        manifest = {}
+
+    assert replicas_that_fit(_Legacy(), est) is None
+    assert auto_replicas(_Legacy(), devices=[None, None, None]) == 3
+
+
 # -- observability -----------------------------------------------------------
 
 def test_fleet_replica_metrics_and_steplog(tmp_path):

@@ -286,3 +286,55 @@ def test_gru_fused_bf16_tracks_f32():
         want32 = np.asarray(want, np.float32)
         denom = max(1.0, float(np.abs(want32).max()))
         assert float(np.abs(got32 - want32).max()) / denom < 8e-2
+
+
+# -- int8 dequant matmul (quantized serving bundles) --------------------------
+
+def _int8_case(m=5, k=72, n=256, seed=3):
+    from paddle_tpu.serve.quantize import quantize_int8
+
+    rng = np.random.RandomState(seed)
+    w = rng.randn(k, n).astype(np.float32) / np.sqrt(k)
+    q, scale = quantize_int8(w)
+    x = rng.randn(m, k).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(q), jnp.asarray(scale)
+
+
+def test_int8_matmul_kernel_matches_xla_fallback(monkeypatch):
+    """The Pallas int8-dot kernel and the XLA dequant-fused fallback
+    must agree bit-for-bit at f32 (same dequant, same contraction
+    order per column block)."""
+    from paddle_tpu.utils import flags
+
+    x, q, scale = _int8_case()
+    monkeypatch.setattr(flags, "_values",
+                        dict(flags._values, int8_matmul="off"))
+    ref = pk.int8_matmul(x, q, scale)
+    monkeypatch.setattr(flags, "_values",
+                        dict(flags._values, int8_matmul="on"))
+    assert pk._int8_matmul_take_kernel(x.shape[0], x.shape[1],
+                                       q.shape[1], x.dtype)
+    got = pk.int8_matmul(x, q, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5)
+    # leading batch dims flatten through the kernel and reshape back
+    x3 = jnp.reshape(jnp.concatenate([x, x]), (2,) + tuple(x.shape))
+    got3 = pk.int8_matmul(x3, q, scale)
+    assert got3.shape == (2, x.shape[0], q.shape[1])
+    np.testing.assert_allclose(np.asarray(got3[0]), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_int8_matmul_gate_defaults_to_xla_path():
+    """Default-safe dispatch (the ops/pallas_conv.py convention):
+    ``auto`` fires only for (K, N) shapes with a recorded on-chip win —
+    the gate ships empty, so the kernel never takes over untested."""
+    assert pk._INT8_MEASURED_WINS == frozenset()
+    assert not pk._int8_matmul_take_kernel(5, 72, 256, jnp.float32)
+    # unsupported shapes refuse even when forced: N must be 128-aligned
+    assert pk.int8_matmul_mode(5, 72, 100, jnp.float32) is None
+    x, q, scale = _int8_case(n=256)
+    out = pk.int8_matmul(x, q, scale)  # XLA dequant-fused path
+    want = np.asarray(x) @ (np.asarray(q, np.float32)
+                            * np.asarray(scale))
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)

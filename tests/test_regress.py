@@ -24,11 +24,40 @@ def test_direction_from_unit_and_metric():
     assert regress.direction({"unit": "ms/batch"}) == -1
     assert regress.direction({"unit": "samples/s"}) == 1
     assert regress.direction({"unit": "qps"}) == 1
+    # footprint rows gate lower-better, capacity rows higher-better
+    # (the quantized-bundle rows: hbm_estimate_bytes / replicas-fit)
+    assert regress.direction({"unit": "bytes"}) == -1
+    assert regress.direction({"unit": "replicas"}) == 1
     assert regress.direction(
         {"metric": "x_train_samples_per_sec_bs64"}) == 1
     assert regress.direction({"metric": "x_train_ms_per_batch_bs1"}) == -1
     assert regress.direction({"metric": "mystery", "unit": "widgets"}) \
         is None
+
+
+def test_bytes_rows_gate_lower_is_better():
+    """The quantized bundle's hbm_estimate_bytes row gates like any
+    other bench metric: growing back toward the fp footprint is a
+    regression; shrinking further passes."""
+    best = {"serve_quant_hbm_int8_bytes":
+            {"metric": "serve_quant_hbm_int8_bytes", "value": 140000,
+             "unit": "bytes", "_source": "BENCH_test.json"}}
+    worse = regress.check_row(
+        {"metric": "serve_quant_hbm_int8_bytes", "value": 200000,
+         "unit": "bytes"}, best)
+    assert worse["status"] == "regression"
+    better = regress.check_row(
+        {"metric": "serve_quant_hbm_int8_bytes", "value": 120000,
+         "unit": "bytes"}, best)
+    assert better["status"] == "ok"
+    # replicas-that-fit: FEWER fitting replicas is the regression
+    fit_best = {"serve_quant_replicas_fit":
+                {"metric": "serve_quant_replicas_fit", "value": 29,
+                 "unit": "replicas", "_source": "BENCH_test.json"}}
+    fewer = regress.check_row(
+        {"metric": "serve_quant_replicas_fit", "value": 8,
+         "unit": "replicas"}, fit_best)
+    assert fewer["status"] == "regression"
 
 
 def test_audited_rows_parse_the_driver_record_shape():
