@@ -107,6 +107,12 @@ HOT_PATHS = {
     # on every serving dispatch of every quantized bundle
     "serve/quantize.py": {"dequant_for_trace", "dequantize"},
     "data/feeder.py": {"_produce", "batches", "chunks"},
+    # the async checkpoint writer: submit runs ON the step thread every
+    # cadence hit, and the writer loop shares state with it — a stray
+    # host sync or an unlocked access here stalls or tears every
+    # checkpointing run (PTA003-PTA008 cover the thread/lock idioms)
+    "distributed/checkpoint.py": {"submit", "drain", "_writer_loop",
+                                  "_write"},
     # per-step dispatch paths that predate PTA001: the cluster worker's
     # whole train loop and the mesh strategy's per-step wrappers
     "distributed/worker.py": {"main"},

@@ -136,7 +136,12 @@ def cmd_train(args):
 
     trainer.train(reader, num_passes=args.num_passes, event_handler=handler,
                   feed_pipeline=getattr(args, "feed_pipeline", 0) or False,
-                  steps_per_call=getattr(args, "steps_per_call", 0) or None)
+                  steps_per_call=getattr(args, "steps_per_call", 0) or None,
+                  checkpoint_dir=getattr(args, "checkpoint_dir", "") or None,
+                  checkpoint_every=getattr(args, "checkpoint_every", 0),
+                  checkpoint_keep=getattr(args, "checkpoint_keep", 3),
+                  checkpoint_sync=getattr(args, "checkpoint_sync", False),
+                  resume=getattr(args, "resume", False))
     if hasattr(cfg, "test_reader"):
         result = trainer.test(minibatch.batch(cfg.test_reader(), batch_size))
         print("test cost=%.6f metrics=%s" % (result.cost, result.metrics))
@@ -566,6 +571,13 @@ def cmd_observe(args):
                   "(%d pipelined batches)%s"
                   % (run["feed_stall_ms_p50"], run["feed_stall_ms_p95"],
                      run["feed_batches"], waste))
+        if "checkpoints" in run:
+            thread = (", step-thread p95 %.3f ms"
+                      % run["checkpoint_step_thread_ms_p95"]
+                      if "checkpoint_step_thread_ms_p95" in run else "")
+            print("    checkpoints: %d  save p95 %.3f ms  %.1f KB total%s"
+                  % (run["checkpoints"], run["checkpoint_ms_p95"],
+                     run["checkpoint_bytes_total"] / 1024.0, thread))
         if "examples_per_sec_best" in run:
             print("    examples/sec best: %.1f"
                   % run["examples_per_sec_best"])
@@ -729,6 +741,24 @@ def main(argv=None):
                    help="fuse K optimizer steps per dispatch (lax.scan "
                         "with donated carries, docs/data.md); implies "
                         "the pipelined feed; 0 = one dispatch per step")
+    p.add_argument("--checkpoint-dir", default="",
+                   help="durable full-training-state checkpoints "
+                        "(parameters + optimizer slots + rng + reader "
+                        "cursor; docs/distributed.md)")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="checkpoint cadence in global steps; saves are "
+                        "OVERLAPPED (async ckpt-writer thread) unless "
+                        "--checkpoint-sync; 0 = off")
+    p.add_argument("--checkpoint-keep", type=int, default=3,
+                   help="checkpoints retained (older ones pruned)")
+    p.add_argument("--checkpoint-sync", action="store_true",
+                   help="block the step thread for each save (the A/B "
+                        "contrast; benchmark/exp_checkpoint.py)")
+    p.add_argument("--resume", action="store_true",
+                   help="restore the newest valid checkpoint in "
+                        "--checkpoint-dir and continue the IDENTICAL "
+                        "fixed-seed trajectory (reader position, rng and "
+                        "optimizer slots included)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("test", parents=[common])

@@ -194,12 +194,23 @@ class DeviceFeeder:
             feed = self.parallelism.shard_batch(feed)
         return feed
 
-    def _produce(self, q, cancel):
+    def _produce(self, q, cancel, skip=0):
         def put(item):
             return _cancellable_put(q, item, cancel)
 
         try:
             for data_batch in self.reader():
+                if skip > 0:
+                    # deterministic-resume cursor (trainer train(resume=)):
+                    # the already-trained batch prefix is consumed from
+                    # the reader (so ordering downstream is untouched)
+                    # but never converted or device-placed. Still honor
+                    # cancellation: a consumer abandoning mid-prefix
+                    # must not leak this thread for the rest of it
+                    if cancel.is_set():
+                        return
+                    skip -= 1
+                    continue
                 t0 = time.perf_counter()
                 feed = self._convert_batch(data_batch)
                 convert_ms = (time.perf_counter() - t0) * 1e3
@@ -217,13 +228,15 @@ class DeviceFeeder:
         put(_End)
 
     # -- consumer side ------------------------------------------------------
-    def batches(self):
+    def batches(self, skip=0):
         """Generator of FeedBatch items; owns the producer thread for
-        its lifetime (closing the generator cancels and joins it)."""
+        its lifetime (closing the generator cancels and joins it).
+        ``skip=N`` drops the reader's first N batches unconverted — the
+        resume cursor of a checkpointed run (docs/distributed.md)."""
         q = queue.Queue(maxsize=self.depth)
         cancel = threading.Event()
         thread = threading.Thread(
-            target=self._produce, args=(q, cancel),
+            target=self._produce, args=(q, cancel, int(skip)),
             name="data-feeder-producer", daemon=True)
         thread.start()
         try:
@@ -249,10 +262,12 @@ class DeviceFeeder:
             _drain(q)
             thread.join(timeout=5.0)
 
-    def chunks(self, k):
+    def chunks(self, k, skip=0):
         """Generator of :class:`ChunkBatch` groups of up to ``k``
         consecutive, shape-compatible batches (the fused-loop feed,
-        ``trainer.SGD.train steps_per_call=``).
+        ``trainer.SGD.train steps_per_call=``). ``skip`` passes through
+        to :meth:`batches` — the resume cursor counts batches, so a
+        resumed fused run regroups the remainder into fresh chunks.
 
         A queue shallower than ``k`` would silently serialize the fused
         loop — the producer could never stage a full chunk ahead of the
@@ -290,7 +305,7 @@ class DeviceFeeder:
                     split, len(sizes), sum(sizes) / len(sizes), k)
             return self._stack_chunk(group)
 
-        for fb in self.batches():
+        for fb in self.batches(skip=skip):
             fb_key = _feed_shape_key(fb.feed)
             if group and fb_key != key:
                 yield close(group, was_split=True)

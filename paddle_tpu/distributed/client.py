@@ -88,11 +88,24 @@ def spawn_coordinator_on_free_port(snapshot_path="", task_timeout=600.0,
 
 
 class CoordinatorClient:
-    def __init__(self, endpoint, worker_id=None, timeout=10.0):
+    """One worker's RPC handle. NOT thread-safe (one socket + read
+    buffer): a background thread (e.g. elastic.HeartbeatThread) must own
+    its own client over the same endpoint/worker_id.
+
+    ``retry_timeout``/``retry_max_delay``: transport failures retry with
+    capped exponential backoff until the deadline, so a coordinator
+    restart (its own snapshot/recover path takes a few seconds) is
+    invisible to workers instead of an exception."""
+
+    def __init__(self, endpoint, worker_id=None, timeout=10.0,
+                 retry_timeout=30.0, retry_max_delay=2.0):
         host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
         self.addr = (host, int(port))
         self.worker_id = worker_id or "worker-%d" % os.getpid()
         self.timeout = timeout
+        self.retry_timeout = float(retry_timeout)
+        self.retry_max_delay = float(retry_max_delay)
         self._sock = None
         self._buf = b""
 
@@ -104,10 +117,16 @@ class CoordinatorClient:
             self._buf = b""
 
     def call(self, op, **kwargs):
+        """One newline-JSON RPC round trip. Safe to retry across a
+        coordinator restart: every op is lease- or queue-idempotent (a
+        replayed get_task just hands out a fresh lease; a replayed
+        task_finished on a done task is a no-op)."""
         req = {"op": op, "worker": self.worker_id}
         req.update(kwargs)
         payload = (json.dumps(req) + "\n").encode()
-        for attempt in range(3):
+        deadline = time.time() + self.retry_timeout
+        delay = 0.05
+        while True:
             try:
                 self._connect()
                 self._sock.sendall(payload)
@@ -118,11 +137,15 @@ class CoordinatorClient:
                     self._buf += chunk
                 line, self._buf = self._buf.split(b"\n", 1)
                 return json.loads(line)
-            except (OSError, ConnectionError, json.JSONDecodeError):
+            except (OSError, ConnectionError, json.JSONDecodeError) as exc:
                 self.close()
-                if attempt == 2:
+                remaining = deadline - time.time()
+                if remaining <= 0:
                     raise
-                time.sleep(0.2 * (attempt + 1))
+                logger.debug("coordinator rpc %s failed (%s); retrying for "
+                             "another %.1fs", op, exc, remaining)
+                time.sleep(min(delay, remaining))
+                delay = min(delay * 2.0, self.retry_max_delay)
 
     def close(self):
         if self._sock is not None:

@@ -1,0 +1,339 @@
+"""Elastic membership + lost-worker recovery over the coordinator.
+
+The reference's second generation existed to survive preemption: an
+etcd-coordinated Go master handed out recoverable task leases and
+workers held TTL'd membership keys a keep-alive goroutine renewed
+(PAPER.md SURVEY "Cloud-native Go runtime"). The modern equivalent here
+rides the existing C++ coordinator's lease table (register/heartbeat
+ops, ``distributed/coordinator/coordinator.cc``):
+
+* every training process REGISTERS under a TTL lease and renews it from
+  a named background thread (:class:`HeartbeatThread` — one renewal per
+  ttl/3, the etcd keep-alive cadence);
+* the step thread watches the membership set at step boundaries
+  (:class:`MembershipWatch` — one cheap ``workers`` RPC at most every
+  ``poll_secs``); a peer whose lease lapsed raises :class:`WorkerLost`
+  at the NEXT boundary, never mid-step;
+* recovery (:func:`run_elastic`) is deterministic and coordination-free:
+  every survivor independently rewinds to the last committed checkpoint
+  (``trainer.train(resume="pass")`` — docs/distributed.md) and re-deals
+  ALL data shards over the survivor set with :func:`deal_shards`, a pure
+  function of the sorted shard and worker-id lists, so the dead worker's
+  shards land on survivors identically everywhere with no extra
+  coordination round.
+
+Multi-host note: within one process group, recovery re-deals data and
+rewinds state. Re-forming the jax.distributed process group itself
+(fewer hosts) requires a restart — the launcher relaunches survivors
+with ``--resume``, and the checkpoint makes that restart cheap; see
+docs/distributed.md "Lost-worker recovery".
+"""
+
+import threading
+import time
+
+from paddle_tpu.utils.error import enforce
+from paddle_tpu.utils.logger import logger
+
+
+class WorkerLost(RuntimeError):
+    """A peer's membership lease lapsed; raised at a step boundary."""
+
+    def __init__(self, lost, remaining):
+        self.lost = sorted(lost)
+        self.remaining = sorted(remaining)
+        super().__init__("lost worker(s) %s; %d survive"
+                         % (self.lost, len(self.remaining)))
+
+
+class SelfLeaseLost(RuntimeError):
+    """This worker's OWN lease lapsed (partitioned from the coordinator
+    longer than ttl): the peers have already declared it dead and
+    re-dealt its shards, so continuing on the old deal would train those
+    shards TWICE and fork the group's trajectory. Deliberately NOT a
+    :class:`WorkerLost` — run_elastic must not absorb it into a local
+    reform (the membership this worker sees no longer matches what the
+    survivors dealt over). The launcher restarts the process with
+    ``--resume``, same as any other death."""
+
+
+class HeartbeatThread:
+    """Named daemon thread ("coord-heartbeat") renewing this worker's
+    coordinator lease every ttl/3. Owns a PRIVATE CoordinatorClient over
+    the endpoint (the client class is single-threaded); transient RPC
+    failures are absorbed by the client's own capped-backoff retry, and
+    anything escaping that is counted, logged and survived — a missed
+    beat only matters if ttl lapses, which is the coordinator's call."""
+
+    def __init__(self, endpoint, worker_id, ttl=10.0):
+        from paddle_tpu.distributed.client import CoordinatorClient
+
+        self.ttl = float(ttl)
+        enforce(self.ttl > 0, "heartbeat ttl must be positive, got %r", ttl)
+        # a renewal that cannot land within ttl is lost anyway — bound
+        # the client's transport retries by it so shutdown never waits
+        # out the full default retry window behind a dead coordinator
+        self._client = CoordinatorClient(endpoint, worker_id=worker_id,
+                                         retry_timeout=self.ttl)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._beats = 0
+        self._errors = 0
+        self._last_ok = None
+        self._thread = threading.Thread(target=self._loop,
+                                        name="coord-heartbeat", daemon=True)
+
+    def start(self):
+        """Register the lease, then start renewing it."""
+        self._client.register(ttl=self.ttl)
+        with self._lock:
+            self._last_ok = time.monotonic()
+        self._thread.start()
+        return self
+
+    def lease_lapsed(self):
+        """True when no renewal has SUCCEEDED within ttl — the
+        coordinator has (or is about to have) expired this worker's
+        lease, whatever the reason on our side. Heartbeats re-register
+        transparently on reconnect, so without this check a partitioned
+        worker would rejoin silently after its peers already re-dealt
+        its shards."""
+        with self._lock:
+            last = self._last_ok
+        return last is not None and time.monotonic() - last > self.ttl
+
+    def stop(self):
+        """Stop renewing and join; the lease lapses naturally after ttl
+        (a crashed worker and a stopped one look identical upstream).
+        The client is single-threaded and owned by the loop thread, so
+        it is only closed here once that thread is confirmed dead — a
+        join timeout (thread still mid-RPC) leaves the socket to the
+        daemon thread rather than yanking it out from under it."""
+        self._stop.set()
+        self._thread.join(timeout=max(self.ttl, 5.0))
+        if not self._thread.is_alive():
+            self._client.close()
+
+    def stats(self):
+        with self._lock:
+            return {"beats": self._beats, "errors": self._errors}
+
+    def _loop(self):
+        interval = max(self.ttl / 3.0, 0.05)
+        while not self._stop.wait(interval):
+            try:
+                self._client.heartbeat(ttl=self.ttl)
+                with self._lock:
+                    self._beats += 1
+                    self._last_ok = time.monotonic()
+            except Exception as exc:
+                with self._lock:
+                    self._errors += 1
+                logger.warning("coordinator heartbeat failed: %s", exc)
+
+
+def settled_members(client, poll_secs=0.1, expected=None, timeout=30.0):
+    """Membership snapshot stable enough to deal over: two consecutive
+    polls must agree (and, when ``expected`` is given — the first deal
+    of a fixed-size launch — at least that many workers must be
+    present), so workers dealing at slightly different instants still
+    compute the SAME deal instead of racing each other's register RPCs.
+    Heuristic, not a proof: a lease lapsing right after the deal still
+    reforms through the normal WorkerLost path. Falls back to the
+    current view (with a warning) if membership never settles within
+    ``timeout``."""
+    deadline = time.monotonic() + float(timeout)
+    prev = None
+    while True:
+        cur = set(client.workers())
+        cur.add(client.worker_id)  # own lease may be mid-renewal
+        if cur == prev and (expected is None or len(cur) >= expected):
+            return cur
+        if time.monotonic() >= deadline:
+            logger.warning(
+                "membership did not settle within %.0fs (have %d%s); "
+                "dealing over the current view", timeout, len(cur),
+                "" if expected is None else " of %d expected" % expected)
+            return cur
+        prev = cur
+        time.sleep(max(float(poll_secs) / 2, 0.05))
+
+
+def settled_checkpoint(directory, poll_secs=0.5, timeout=30.0):
+    """Newest committed checkpoint once the shared directory is STABLE
+    (two consecutive polls agree). After a reform abort, a slower
+    survivor's unwind may still be waiting out an in-flight cadence
+    commit — a survivor that restored "latest" before that commit
+    landed would rewind to an older step than its peers and fork the
+    group. Pending (not-yet-started) snapshots are discarded on the
+    WorkerLost unwind (trainer/_checkpoint writer), so the directory
+    settles as soon as every survivor's in-flight write finishes.
+    Heuristic like :func:`settled_members`, with the same
+    fall-back-and-warn on timeout."""
+    from paddle_tpu.distributed import checkpoint as ckpt_mod
+
+    deadline = time.monotonic() + float(timeout)
+    prev = False  # distinct from None: latest may legitimately be None
+    while True:
+        cur = ckpt_mod.latest_checkpoint(directory)
+        if prev is not False and cur == prev:
+            return cur
+        if time.monotonic() >= deadline:
+            logger.warning(
+                "checkpoint dir %s did not settle within %.0fs; "
+                "rewinding to the current newest (%s)", directory,
+                timeout, cur)
+            return cur
+        prev = cur
+        time.sleep(max(float(poll_secs), 0.05))
+
+
+def deal_shards(chunks, workers, worker_id):
+    """This worker's share of ``chunks``: sorted chunks dealt round-robin
+    over the sorted worker ids. A pure function of its inputs, so every
+    survivor computes the identical re-deal after a death with no
+    coordination round, and together the survivors cover every chunk
+    exactly once."""
+    order = sorted(set(workers))
+    enforce(worker_id in order, "worker %r not in membership %s",
+            worker_id, order)
+    idx = order.index(worker_id)
+    return [c for i, c in enumerate(sorted(chunks))
+            if i % len(order) == idx]
+
+
+class MembershipWatch:
+    """Step-boundary lost-worker detection. ``check()`` is cheap enough
+    to call every step: it polls the coordinator's lease table at most
+    every ``poll_secs`` and raises :class:`WorkerLost` when a watched
+    member's lease lapsed. Workers that JOIN are ignored here — they are
+    adopted at the next (re)deal, never mid-pass."""
+
+    def __init__(self, client, members, poll_secs=1.0):
+        self._client = client
+        self.members = set(members)
+        self.poll_secs = float(poll_secs)
+        self._last_poll = float("-inf")
+
+    def check(self):
+        now = time.monotonic()
+        if now - self._last_poll < self.poll_secs:
+            return
+        self._last_poll = now
+        current = set(self._client.workers())
+        lost = self.members - current
+        if not lost:
+            return
+        if self._client.worker_id in lost:
+            # the COORDINATOR already expired this worker's lease, even
+            # if the local lease_lapsed() clock (measured from RPC-reply
+            # receipt) has not tripped yet: peers saw the same lapse and
+            # re-dealt these shards. Absorbing this into a WorkerLost
+            # reform would deal this worker back IN while the survivors
+            # dealt it OUT — the double-trained-shards fork SelfLeaseLost
+            # exists to prevent.
+            raise SelfLeaseLost(
+                "worker %s: own lease expired at the coordinator — peers "
+                "have re-dealt this worker's shards; restart with "
+                "--resume" % self._client.worker_id)
+        raise WorkerLost(lost, self.members & current)
+
+
+def run_elastic(trainer, endpoint, chunks, reader_of, checkpoint_dir,
+                num_passes=1, checkpoint_every=1, checkpoint_keep=3,
+                checkpoint_sync=False, worker_id=None, heartbeat_ttl=10.0,
+                poll_secs=1.0, event_handler=None, max_reforms=8,
+                expected_workers=None, **train_kw):
+    """Preemption-tolerant training driver for one process of an elastic
+    group. ``reader_of(my_shards) -> reader`` builds the minibatch
+    reader over this worker's deal (recordio-shard parity).
+
+    Runs ``trainer.train`` over this worker's deterministic share of
+    ``chunks``; when a peer's lease lapses the loop stops at the next
+    step boundary, rewinds to the last committed checkpoint in
+    ``checkpoint_dir`` (``resume="pass"`` — the shard set changed, so
+    the interrupted pass restarts from its first batch under the NEW
+    deal) and continues over the re-dealt shards. If this worker's OWN
+    lease lapses, :class:`SelfLeaseLost` propagates out instead (the
+    peers already re-dealt around it; the launcher restarts the process
+    with ``--resume``). ``expected_workers=N`` makes the FIRST deal
+    wait (bounded) until the whole fixed-size launch has registered, so
+    early starters don't deal themselves chunks a late registrant also
+    gets. Returns a stats dict: ``reforms`` (mesh re-formations),
+    ``lost`` (worker ids), ``deals`` (this worker's shard list per
+    epoch)."""
+    from paddle_tpu import event as v2_event
+    from paddle_tpu.distributed import checkpoint as ckpt_mod
+    from paddle_tpu.distributed.client import CoordinatorClient
+
+    client = CoordinatorClient(endpoint, worker_id=worker_id)
+    hb = HeartbeatThread(endpoint, client.worker_id,
+                         ttl=heartbeat_ttl).start()
+    stats = {"reforms": 0, "lost": [], "deals": []}
+    resume = False
+    try:
+        # a reform must ALWAYS have a rewind target: without one the
+        # survivors would keep their dirty in-memory weights/rng — each
+        # having stopped at a different step boundary — and silently
+        # diverge. Commit a step-0 baseline before the first step so
+        # "the last committed checkpoint" exists from the start. (Every
+        # worker starts from the same fixed-seed init, so concurrent
+        # baseline writers on a shared dir commit EQUIVALENT snapshots;
+        # save_checkpoint resolves the rename race first-wins.)
+        if ckpt_mod.latest_checkpoint(checkpoint_dir) is None:
+            trainer.save_checkpoint(checkpoint_dir, pass_id=0,
+                                    keep=checkpoint_keep,
+                                    resume_at=(0, 0))
+        while True:
+            # deal over a SETTLED snapshot (two agreeing polls; the
+            # first deal of a fixed-size launch additionally waits for
+            # expected_workers) so peers dealing at different instants
+            # don't split the chunks over different membership views
+            members = settled_members(
+                client, poll_secs=poll_secs,
+                expected=(expected_workers if not stats["deals"]
+                          else None))
+            mine = deal_shards(chunks, members, client.worker_id)
+            stats["deals"].append(list(mine))
+            watch = MembershipWatch(client, members, poll_secs=poll_secs)
+
+            def handler(evt, _watch=watch):
+                if event_handler is not None:
+                    event_handler(evt)
+                if isinstance(evt, v2_event.EndIteration):
+                    if hb.lease_lapsed():
+                        raise SelfLeaseLost(
+                            "worker %s: own lease lapsed (no successful "
+                            "renewal within ttl=%.1fs) — peers have "
+                            "re-dealt this worker's shards; restart with "
+                            "--resume" % (client.worker_id, hb.ttl))
+                    _watch.check()
+
+            try:
+                trainer.train(reader_of(mine), num_passes=num_passes,
+                              event_handler=handler,
+                              checkpoint_dir=checkpoint_dir,
+                              checkpoint_every=checkpoint_every,
+                              checkpoint_keep=checkpoint_keep,
+                              checkpoint_sync=checkpoint_sync,
+                              resume=("pass" if resume else False),
+                              **train_kw)
+                return stats
+            except WorkerLost as exc:
+                stats["reforms"] += 1
+                stats["lost"].extend(exc.lost)
+                enforce(stats["reforms"] <= max_reforms,
+                        "gave up after %d mesh re-formations (last: %s)",
+                        stats["reforms"], exc)
+                logger.warning(
+                    "mesh reform %d (%s): rewinding to the last committed "
+                    "checkpoint and re-dealing the dead worker's shards",
+                    stats["reforms"], exc)
+                # survivors abort at different boundaries: wait for the
+                # shared directory to stop changing before the restore,
+                # so every survivor rewinds to the SAME checkpoint
+                settled_checkpoint(checkpoint_dir, poll_secs=poll_secs)
+                resume = True
+    finally:
+        hb.stop()
+        client.close()

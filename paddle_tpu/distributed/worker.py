@@ -44,6 +44,31 @@ def main(argv=None):
                          "donated carries — composes with the "
                          "DataParallel global-mesh plan; 0 = one "
                          "dispatch per step)")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="durable training-state checkpoints "
+                         "(distributed/checkpoint.py async overlapped "
+                         "writer; docs/distributed.md)")
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="checkpoint cadence in global steps (0 = off)")
+    ap.add_argument("--resume", nargs="?", const="exact", default=None,
+                    choices=["exact", "pass"],
+                    help="restore the newest valid checkpoint. Bare "
+                         "--resume (= 'exact') continues the identical "
+                         "fixed-seed trajectory at the saved batch "
+                         "cursor — right when the SAME worker set "
+                         "relaunches (a preempted VM came back). "
+                         "'--resume pass' restarts the interrupted pass "
+                         "from its first batch — required when the "
+                         "surviving group is SMALLER, because the "
+                         "re-sharded data stream no longer matches the "
+                         "old cursor (docs/distributed.md)")
+    ap.add_argument("--task-coordinator", default="",
+                    help="host:port of the task coordinator "
+                         "(distributed/client.py): this worker registers "
+                         "a TTL membership lease and renews it from the "
+                         "coord-heartbeat thread, so survivors (and the "
+                         "launcher) detect its death by lease lapse")
+    ap.add_argument("--lease-ttl", type=float, default=10.0)
     args = ap.parse_args(argv)
 
     if args.use_tpu:
@@ -74,11 +99,44 @@ def main(argv=None):
     batch_size = getattr(cfg, "batch_size", None) or args.batch_size or 64
     reader = minibatch.batch(cfg.train_reader(), batch_size)
     costs = []
-    trainer.train(reader, num_passes=args.num_passes,
-                  event_handler=lambda e: costs.append(float(e.cost))
-                  if getattr(e, "cost", None) is not None else None,
-                  feed_pipeline=args.feed_pipeline or False,
-                  steps_per_call=args.steps_per_call or None)
+    heartbeat = None
+    if args.task_coordinator:
+        # membership lease: the coordinator's lease table is how peers
+        # and the launcher learn this worker died (kill -9 included —
+        # the lease just lapses); distributed/elastic.py
+        from paddle_tpu.distributed.elastic import HeartbeatThread
+
+        heartbeat = HeartbeatThread(
+            args.task_coordinator,
+            "trainer-%d" % args.process_id, ttl=args.lease_ttl).start()
+
+    def handler(e):
+        if getattr(e, "cost", None) is not None:
+            costs.append(float(e.cost))
+            # self-lapse gate (distributed/elastic.py SelfLeaseLost):
+            # once our lease lapsed the launcher considers this worker
+            # dead and relaunches a replacement with --resume — training
+            # on would race its checkpoint commits and duplicate shards
+            if heartbeat is not None and heartbeat.lease_lapsed():
+                from paddle_tpu.distributed.elastic import SelfLeaseLost
+
+                raise SelfLeaseLost(
+                    "trainer-%d: own lease lapsed (no successful renewal "
+                    "within ttl=%.1fs); exiting for the relaunch"
+                    % (args.process_id, heartbeat.ttl))
+
+    try:
+        trainer.train(reader, num_passes=args.num_passes,
+                      event_handler=handler,
+                      feed_pipeline=args.feed_pipeline or False,
+                      steps_per_call=args.steps_per_call or None,
+                      checkpoint_dir=args.checkpoint_dir or None,
+                      checkpoint_every=args.checkpoint_every,
+                      resume={"exact": True, "pass": "pass"}.get(
+                          args.resume, False))
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
 
     final = {"process_id": args.process_id,
              "processes": jax.process_count(),

@@ -61,6 +61,14 @@ Record types (field ``type``):
   priority-class shed policy): ``model``, ``reason``
   (``queue_full``/``pressure``), optional ``priority`` and ``queued``
   (queue state that triggered the shed).
+* ``checkpoint`` — one committed training checkpoint
+  (distributed/checkpoint.py): ``step`` (global step the snapshot
+  captured), ``duration_ms`` (serialize + fsync + atomic rename, on the
+  writer thread for overlapped saves), optional ``bytes`` (directory
+  payload), ``overlapped`` (True = async writer thread, False =
+  blocking save on the step thread), ``step_thread_ms`` (what the save
+  actually cost the step thread: the jitted snapshot clone + handoff),
+  ``pass`` and ``path`` (checkpoint directory basename).
 * ``anomaly`` — a sentinel trip (observe/sentinel.py): ``step``,
   ``kind`` (``nan_inf_loss``/``loss_divergence``), optional ``cost``
   (repr string when non-finite), ``threshold``, ``mode``, ``pass``.
@@ -459,6 +467,28 @@ class StepLog:
             rec["queued"] = int(queued)
         self.write(rec)
 
+    def log_checkpoint(self, step, duration_ms, nbytes=None,
+                       overlapped=None, step_thread_ms=None, pass_id=None,
+                       path=None):
+        """One committed training checkpoint (distributed/checkpoint.py
+        AsyncCheckpointer, or a blocking trainer save). ``duration_ms``
+        is the full serialize+fsync+rename cost; ``step_thread_ms`` is
+        the slice of it the STEP THREAD paid — the overlap evidence."""
+        rec = {"type": "checkpoint", "step": int(step),
+               "duration_ms": round(float(duration_ms), 4),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if nbytes is not None:
+            rec["bytes"] = int(nbytes)
+        if overlapped is not None:
+            rec["overlapped"] = bool(overlapped)
+        if step_thread_ms is not None:
+            rec["step_thread_ms"] = round(float(step_thread_ms), 4)
+        if pass_id is not None:
+            rec["pass"] = int(pass_id)
+        if path is not None:
+            rec["path"] = str(path)
+        self.write(rec)
+
     def log_anomaly(self, step, kind, cost=None, threshold=None,
                     mode=None, pass_id=None, chunk_index=None):
         """One sentinel trip (observe/sentinel.py). ``chunk_index`` is
@@ -646,6 +676,20 @@ def summarize_dir(directory):
             spc = meta.get("steps_per_call")
             if spc is not None:
                 run["steps_per_call"] = spc
+        ckpts = [r for r in records if r.get("type") == "checkpoint"]
+        if ckpts:
+            from paddle_tpu.observe.metrics import percentile
+
+            durations = [r["duration_ms"] for r in ckpts]
+            run["checkpoints"] = len(ckpts)
+            run["checkpoint_ms_p95"] = round(percentile(durations, 95), 3)
+            run["checkpoint_bytes_total"] = sum(r.get("bytes", 0)
+                                                for r in ckpts)
+            thread_ms = [r["step_thread_ms"] for r in ckpts
+                         if "step_thread_ms" in r]
+            if thread_ms:
+                run["checkpoint_step_thread_ms_p95"] = round(
+                    percentile(thread_ms, 95), 3)
         serve = _serve_replica_summary(records)
         if serve:
             run["serve_replicas"] = serve

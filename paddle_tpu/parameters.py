@@ -106,6 +106,17 @@ class Parameters:
     def as_dict(self):
         return dict(self._values)
 
+    def copy(self):
+        """Shallow copy: fresh name->value/spec dicts over the SAME
+        arrays (values are never mutated in place, so sharing is safe).
+        The async checkpointer snapshots this on the step thread and
+        overlays the device snapshot on the writer thread — the live
+        Parameters object is never touched off-thread."""
+        clone = Parameters()
+        clone._values = dict(self._values)
+        clone._specs = dict(self._specs)
+        return clone
+
     def update_from(self, values):
         for key, val in values.items():
             if key in self._values:

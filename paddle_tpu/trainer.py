@@ -229,11 +229,16 @@ class SGD:
             self._replica = _make_replica(self._trainable)
         self._rng = jax.random.PRNGKey(flags.get_flag("seed") or 0)
         self._step_count = 0
+        # the live AsyncCheckpointer while train(checkpoint_dir=...) runs
+        # (chaos harness/elastic runner poll .last_committed())
+        self._ckpt_writer = None
 
     # -- main loop ----------------------------------------------------------
     def train(self, reader, num_passes=1, event_handler=None, feeding=None,
               sync_params=True, test_reader=None, feed_pipeline=False,
-              buckets=None, steps_per_call=None):
+              buckets=None, steps_per_call=None, checkpoint_dir=None,
+              checkpoint_every=0, checkpoint_keep=3, resume=False,
+              checkpoint_sync=False):
         """Event-driven training (v2 SGD.train parity). ``reader`` yields
         minibatches (lists of sample tuples). With ``test_reader`` and a
         nonzero ``test_period`` flag, an evaluation pass runs every N
@@ -276,6 +281,27 @@ class SGD:
         untouched. Partial final chunks (K does not divide the pass
         length, or a bucket boundary splits a chunk) scan at their own
         length — one extra compile per distinct chunk size.
+
+        ``checkpoint_dir`` + ``checkpoint_every=N`` (docs/distributed.md):
+        every N global steps a full training-state snapshot — parameters,
+        BN state, optimizer slots, the threefry key and the reader
+        position (pass id + batch cursor) — is committed durably.
+        By default the save is OVERLAPPED: the step thread pays one
+        jitted device-side buffer clone + an async device→host kick,
+        and a named background writer (``ckpt-writer``) does the
+        serialization + fsync + atomic rename (the additive
+        ``checkpoint`` steplog record carries duration/bytes/overlap);
+        ``checkpoint_sync=True`` blocks the step thread instead (the
+        A/B contrast, ``benchmark/exp_checkpoint.py``). ``resume=True``
+        restores the newest valid checkpoint in ``checkpoint_dir``
+        before training and continues the IDENTICAL fixed-seed
+        trajectory: earlier passes are skipped, the resumed pass's
+        already-trained batches are skipped via the feeder's batch
+        cursor, and the rng/optimizer state pick up exactly where the
+        snapshot was taken. ``num_passes`` stays the TOTAL pass count
+        (a run resumed from pass 1 of 3 trains passes 1..2). Under a
+        fused loop (``steps_per_call=K``) checkpoints land at chunk
+        boundaries — the first step boundary at or past the cadence.
         """
         if event_handler is None:
             event_handler = default_event_handler
@@ -331,9 +357,19 @@ class SGD:
         # PADDLE_TPU_SENTINEL governs warn/halt/off; the crash artifact
         # lands next to the steplog when telemetry is on
         sentinel = observe_sentinel.from_env(steplog=slog)
+        start_pass = start_cursor = 0
+        if checkpoint_dir and resume:
+            start_pass, start_cursor = self._resume_restore(checkpoint_dir,
+                                                            mode=resume)
+        ckpt_ctx = None
+        if checkpoint_dir and checkpoint_every:
+            ckpt_ctx = self._checkpoint_setup(
+                checkpoint_dir, checkpoint_every, checkpoint_keep,
+                checkpoint_sync, slog)
         # first step's wall interval is anchored at train start, so the
         # first record honestly includes compile time (the compile shows
         # up as an ``event`` record too when jax.monitoring emits it)
+        completed = False
         last_final = {"t": time.perf_counter()}
         try:
             if k:
@@ -341,26 +377,63 @@ class SGD:
                     reader, num_passes, event_handler, feeding,
                     sync_params, test_reader, log_period, test_period,
                     slog, last_final, sentinel, k,
-                    feed_depth=self._feed_depth(feed_pipeline))
+                    feed_depth=self._feed_depth(feed_pipeline),
+                    start_pass=start_pass, start_cursor=start_cursor,
+                    ckpt=ckpt_ctx)
             else:
                 self._train_passes(reader, num_passes, event_handler,
                                    feeding, sync_params, test_reader,
                                    log_period, test_period, slog,
                                    last_final, sentinel,
-                                   feed_pipeline=feed_pipeline)
+                                   feed_pipeline=feed_pipeline,
+                                   start_pass=start_pass,
+                                   start_cursor=start_cursor,
+                                   ckpt=ckpt_ctx)
+            completed = True
         except BaseException as exc:
             # any escape from the training loop dumps the black box
             # (a sentinel halt already dumped; on_exception skips it)
             if sentinel is not None:
                 sentinel.on_exception(exc)
+            if ckpt_ctx is not None and ckpt_ctx["writer"] is not None:
+                from paddle_tpu.distributed.elastic import (SelfLeaseLost,
+                                                            WorkerLost)
+
+                if isinstance(exc, (WorkerLost, SelfLeaseLost)):
+                    # reform abort: each worker stops at its OWN step
+                    # boundary, so draining the pending snapshot here
+                    # would advance the shared directory's rewind target
+                    # differently per worker; everyone must rewind to
+                    # the same committed checkpoint (run_elastic settles
+                    # the directory before it restores). A self-lapsed
+                    # worker especially: its peers have already
+                    # reformed, so its pending snapshot is from the
+                    # ABANDONED pre-reform branch — committing it would
+                    # hand the next rewind pre-reform state.
+                    ckpt_ctx["writer"].discard_pending()
             raise
         finally:
-            if slog is not None:
-                try:
-                    tracer.export(slog.trace_path)
-                finally:
-                    tracer.record_events = prev_recording
-                    slog.close()
+            # ``completed`` (not sys.exc_info(), which also reports an
+            # OUTER handled exception when train() runs inside an except
+            # block) decides who wins: on a normal exit a writer error
+            # must surface, while an exception already unwinding must
+            # stay visible over the writer's
+            try:
+                # drain + join the ckpt-writer thread; a writer error
+                # surfaces HERE
+                if ckpt_ctx is not None:
+                    self._checkpoint_close(ckpt_ctx)
+            except Exception:
+                if completed:
+                    raise
+                logger.exception("checkpoint writer error during unwind")
+            finally:
+                if slog is not None:
+                    try:
+                        tracer.export(slog.trace_path)
+                    finally:
+                        tracer.record_events = prev_recording
+                        slog.close()
 
     # process-wide training metrics (observe/metrics.py; scraped through
     # any serve front end in the same process, snapshot()-able anywhere)
@@ -378,17 +451,41 @@ class SGD:
 
     def _train_passes(self, reader, num_passes, event_handler, feeding,
                       sync_params, test_reader, log_period, test_period,
-                      slog, last_final, sentinel=None, feed_pipeline=False):
+                      slog, last_final, sentinel=None, feed_pipeline=False,
+                      start_pass=0, start_cursor=0, ckpt=None):
         (m_steps, m_examples, m_loss,
          m_examples_per_sec) = self._train_metrics()
         # ONE feeder across passes (batches() starts a fresh producer
         # thread per pass) so its cumulative per-bucket fill/waste
         # gauges span the whole run, like the serve engine's
         feeder = None
-        for pass_id in range(num_passes):
+        for pass_id in range(start_pass, num_passes):
+            # resumed pass: the first ``start_cursor`` batches were
+            # already trained before the checkpoint — skip them on the
+            # stream so batch numbering (and every event/record keyed on
+            # it) continues exactly where the snapshot left off
+            cursor0 = start_cursor if pass_id == start_pass else 0
+            if not feed_pipeline:
+                batch_iter = iter(reader())
+                for _ in range(cursor0):  # deterministic resume skip
+                    if next(batch_iter, None) is None:
+                        break
+            else:
+                from paddle_tpu.data.feeder import DeviceFeeder
+
+                if feeder is None:
+                    feeder = DeviceFeeder(
+                        reader, self.topology, feeding=feeding,
+                        depth=self._feed_depth(feed_pipeline),
+                        parallelism=self.parallelism)
+                batch_iter = feeder.batches(skip=cursor0)
+            if cursor0:
+                batch_iter = self._resume_pass_iter(batch_iter, pass_id)
+                if batch_iter is None:
+                    continue  # pass was complete at the checkpoint
             event_handler(v2_event.BeginPass(pass_id))
             eval_acc = {e.name: None for e in self.evaluators}
-            batch_id = 0
+            batch_id = cursor0
             # One-deep input pipeline (PyDataProvider2 pool-thread parity,
             # TPU-shaped): step k+1's feed is converted and DISPATCHED
             # before step k's loss/stats are fetched from the device, so
@@ -454,9 +551,9 @@ class SGD:
                 event_handler(v2_event.EndIteration(
                     pass_id, b_id, loss, metrics))
 
-            self._pass_step_base = self._step_count
+            self._pass_step_base = self._step_count - cursor0
             if not feed_pipeline:
-                for data_batch in reader():
+                for data_batch in batch_iter:
                     event_handler(v2_event.BeginIteration(pass_id, batch_id))
                     with observe_spans.span("feed") as feed_scope:
                         feed = convert_feed(
@@ -469,6 +566,7 @@ class SGD:
                             self._trainable, self._replica, self._static,
                             self._state, self._opt_state, feed, step_rng)
                     self._step_count += 1
+                    self._checkpoint_maybe(ckpt, pass_id, batch_id + 1)
                     if pending is not None:
                         finalize(pending)
                     pending = (batch_id, loss, stats, feed,
@@ -483,14 +581,7 @@ class SGD:
                 # batch writes a ``feed`` steplog record). feed_ms on the
                 # step record = the stall, the host time actually charged
                 # to the step thread.
-                from paddle_tpu.data.feeder import DeviceFeeder
-
-                depth = self._feed_depth(feed_pipeline)
-                if feeder is None:
-                    feeder = DeviceFeeder(reader, self.topology,
-                                          feeding=feeding, depth=depth,
-                                          parallelism=self.parallelism)
-                for fb in feeder.batches():
+                for fb in batch_iter:
                     event_handler(v2_event.BeginIteration(pass_id, batch_id))
                     self._rng, step_rng = jax.random.split(self._rng)
                     with observe_spans.span("train_step"):
@@ -499,11 +590,12 @@ class SGD:
                             self._trainable, self._replica, self._static,
                             self._state, self._opt_state, fb.feed, step_rng)
                     self._step_count += 1
+                    self._checkpoint_maybe(ckpt, pass_id, batch_id + 1)
                     if slog is not None:
                         slog.log_feed(
                             step=self._step_count, stall_ms=fb.stall_ms,
                             convert_ms=fb.convert_ms, examples=fb.examples,
-                            depth=depth, bucket=fb.bucket,
+                            depth=feeder.depth, bucket=fb.bucket,
                             fill_tokens=fb.fill_tokens,
                             pad_tokens=fb.pad_tokens)
                     if pending is not None:
@@ -554,7 +646,8 @@ class SGD:
     def _train_passes_fused(self, reader, num_passes, event_handler,
                             feeding, sync_params, test_reader, log_period,
                             test_period, slog, last_final, sentinel, k,
-                            feed_depth=2):
+                            feed_depth=2, start_pass=0, start_cursor=0,
+                            ckpt=None):
         """The steps_per_call=K loop: chunks of K device-resident feeds
         (DeviceFeeder.chunks) through ONE scan dispatch, one-deep
         pipelined like the per-step loop — chunk c+1 is dispatched before
@@ -572,10 +665,20 @@ class SGD:
         feeder = DeviceFeeder(reader, self.topology, feeding=feeding,
                               depth=max(int(feed_depth), k),
                               parallelism=self.parallelism)
-        for pass_id in range(num_passes):
+        for pass_id in range(start_pass, num_passes):
+            # resumed pass: skip the already-trained batch prefix (the
+            # checkpoint cursor counts BATCHES, so a resume lands exactly
+            # even when chunk regrouping differs — the fused math is
+            # K-invariant)
+            cursor0 = start_cursor if pass_id == start_pass else 0
+            chunk_iter = feeder.chunks(k, skip=cursor0)
+            if cursor0:
+                chunk_iter = self._resume_pass_iter(chunk_iter, pass_id)
+                if chunk_iter is None:
+                    continue  # pass was complete at the checkpoint
             event_handler(v2_event.BeginPass(pass_id))
             eval_acc = {e.name: None for e in self.evaluators}
-            batch_id = 0
+            batch_id = cursor0
             pending = None  # (batch_id, base_step, losses, stats, chunk)
 
             def finalize(item):
@@ -662,7 +765,7 @@ class SGD:
                     event_handler(v2_event.EndIteration(
                         pass_id, b_id + i, cost_i, metrics))
 
-            for chunk in feeder.chunks(k):
+            for chunk in chunk_iter:
                 # every real step of the chunk announces itself before
                 # the fused dispatch, so the reference ordering
                 # BeginIteration(b) < EndForwardBackward(b) <
@@ -696,6 +799,10 @@ class SGD:
                             step_rng)
                 base_step = self._step_count
                 self._step_count += chunk.steps
+                # chunk boundary == step boundary: the first one at or
+                # past the cadence commits the snapshot
+                self._checkpoint_maybe(ckpt, pass_id,
+                                       batch_id + chunk.steps)
                 if slog is not None:
                     for i, fb in enumerate(chunk.batches):
                         slog.log_feed(
@@ -732,6 +839,32 @@ class SGD:
         """Global step number of a pipelined batch being finalized (the
         periodic-stats/test triggers keep their pre-pipelining schedule)."""
         return self._pass_step_base + batch_id + 1
+
+    @staticmethod
+    def _resume_pass_iter(batch_iter, pass_id):
+        """Peek the resumed pass's post-skip stream. A checkpoint cursor
+        sitting exactly at the pass boundary (checkpoint_every divides
+        the pass length) leaves NOTHING to train: every batch of the
+        pass is already in the snapshot. Returns None then (the caller
+        skips the pass), or an iterator equivalent to ``batch_iter``
+        with the peeked item restored.
+
+        The pass's EndPass either fired before the crash or its
+        evaluator accumulator died in-memory with the process; either
+        way the resumed run cannot reconstruct it — re-emitting EndPass
+        here would read the EMPTY accumulator as a falsely-perfect pass
+        record and re-run the per-pass test, so a crash landing in the
+        narrow commit→EndPass window loses that pass's record rather
+        than fabricating one."""
+        first = next(batch_iter, None)
+        if first is None:
+            logger.info("resume: pass %d was already complete at the "
+                        "checkpoint; continuing with the next pass",
+                        pass_id)
+            return None
+        import itertools
+
+        return itertools.chain([first], batch_iter)
 
     def test(self, reader, feeding=None, pass_id=0):
         """One evaluation pass; returns a TestResult event (v2 SGD.test)."""
@@ -824,12 +957,158 @@ class SGD:
         return export_bundle(output_layer, self.parameters, out_dir,
                              **export_kw)
 
+    # -- preemption-tolerant checkpointing (docs/distributed.md) ------------
+    def _checkpoint_setup(self, directory, every, keep, sync, slog):
+        """One checkpoint session per train() call. Returns the ctx dict
+        the loops thread through ``_checkpoint_maybe``; ``sync=False``
+        (the default) owns an AsyncCheckpointer whose writer thread this
+        session must close in train()'s finally."""
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        every = int(every)
+        enforce(every >= 1, "checkpoint_every must be >= 1, got %d", every)
+        if getattr(self, "_ckpt_clone_jit", None) is None:
+            pool = self._pool
+
+            def clone(trainable, state, opt_state, rng):
+                # fresh device buffers: the next step DONATES the live
+                # carries, so the writer must never hold the originals.
+                # One jitted dispatch; expansion to per-name (the
+                # checkpoint wire format) rides the same program.
+                full = (pool.expand(trainable) if pool is not None
+                        else trainable)
+                return jax.tree.map(jnp.copy,
+                                    {"params": full, "state": state,
+                                     "opt": opt_state, "rng": rng})
+
+            # cached across train() calls: a fresh jit here would
+            # retrace the snapshot program every call, charging each
+            # resumed/repeated run a recompile on its first cadence step
+            self._ckpt_clone_jit = jax.jit(clone)
+        ctx = {"dir": directory, "every": every, "keep": int(keep),
+               "sync": bool(sync), "slog": slog,
+               "writer": (None if sync else ckpt.AsyncCheckpointer(
+                   directory, keep=keep, steplog=slog)),
+               "clone": self._ckpt_clone_jit,
+               "next": (self._step_count // every + 1) * every}
+        self._ckpt_writer = ctx["writer"]
+        return ctx
+
+    def _checkpoint_maybe(self, ctx, pass_id, cursor):
+        """Step-boundary cadence check: commit a snapshot whenever the
+        global step reached the next multiple of ``checkpoint_every``
+        (under a fused loop the boundary is the first chunk boundary at
+        or past it). ``cursor`` = batches consumed within ``pass_id``."""
+        if ctx is None or self._step_count < ctx["next"]:
+            return
+        ctx["next"] = (self._step_count // ctx["every"] + 1) * ctx["every"]
+        if ctx["sync"]:
+            self._checkpoint_blocking(ctx, pass_id, cursor)
+        else:
+            self._checkpoint_overlapped(ctx, pass_id, cursor)
+
+    def _checkpoint_overlapped(self, ctx, pass_id, cursor):
+        """The step thread's whole share of an overlapped save: one
+        jitted device-side clone + an async device→host kick, then the
+        handoff to the ckpt-writer thread (serialization + fsync +
+        atomic rename happen there)."""
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        t0 = time.perf_counter()
+        with observe_spans.span("checkpoint_snapshot",
+                                args={"step": self._step_count}):
+            values = ctx["clone"](self._trainable, self._state,
+                                  self._opt_state, self._rng)
+            for leaf in jax.tree_util.tree_leaves(values):
+                kick = getattr(leaf, "copy_to_host_async", None)
+                if kick is not None:
+                    kick()
+        ms = (time.perf_counter() - t0) * 1e3
+        unpool = self._pool.unpool_state if self._pool is not None else None
+        ctx["writer"].submit(ckpt.CheckpointSnapshot(
+            values, self.parameters.copy(), step=self._step_count,
+            pass_id=pass_id, pass_cursor=cursor, unpool=unpool,
+            step_thread_ms=ms))
+
+    def _checkpoint_blocking(self, ctx, pass_id, cursor):
+        """checkpoint_sync=True: the historical blocking save on the
+        step thread — the A/B contrast for benchmark/exp_checkpoint.py
+        (steplog: overlapped=False, step_thread_ms == duration_ms).
+        The save itself is the public ``save_checkpoint`` (sync-back +
+        unpool + trainer_state), so the two paths cannot diverge."""
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        t0 = time.perf_counter()
+        with observe_spans.span("checkpoint_sync",
+                                args={"step": self._step_count}):
+            path = self.save_checkpoint(ctx["dir"], pass_id=pass_id,
+                                        keep=ctx["keep"],
+                                        resume_at=(pass_id, cursor))
+        ms = (time.perf_counter() - t0) * 1e3
+        if ctx["slog"] is not None:
+            ctx["slog"].log_checkpoint(
+                step=self._step_count, duration_ms=ms,
+                nbytes=ckpt.checkpoint_bytes(path), overlapped=False,
+                step_thread_ms=ms, pass_id=pass_id,
+                path=os.path.basename(path))
+
+    def _checkpoint_close(self, ctx):
+        """Drain + stop the writer; re-raises a writer error so a
+        checkpointing run cannot silently lose durability."""
+        self._ckpt_writer = None
+        if ctx["writer"] is not None:
+            ctx["writer"].close()
+
+    def _resume_restore(self, directory, mode=True):
+        """Restore the newest valid checkpoint for ``train(resume=...)``.
+        Returns ``(start_pass, start_cursor)``: the pass to continue and
+        the batches of it already trained (skipped on the resumed
+        stream). ``mode="pass"`` restarts the interrupted pass from its
+        first batch — the elastic re-deal case, where the shard set
+        changed and the old cursor does not map onto the new stream."""
+        import os
+
+        # resume=True on a first launch (or an elastic reform before the
+        # first commit): the directory save_checkpoint would create does
+        # not exist yet — train from scratch rather than letting
+        # load_checkpoint treat the missing dir as one torn checkpoint
+        if not os.path.isdir(directory):
+            logger.info("resume: checkpoint dir %s does not exist yet; "
+                        "training from scratch", directory)
+            return 0, 0
+        meta = self.restore_checkpoint(directory)
+        if meta is None:
+            logger.info("resume: no valid checkpoint under %s; training "
+                        "from scratch", directory)
+            return 0, 0
+        ts = (meta.get("extra") or {}).get("trainer_state")
+        if not ts:
+            logger.warning(
+                "resume: checkpoint has no trainer_state (pre-elastic "
+                "format): weights/optimizer restored, but the data "
+                "stream and rng restart from pass 0 — the resumed "
+                "trajectory will NOT continue the original one")
+            return 0, 0
+        self._rng = jnp.asarray(np.asarray(ts["rng_key"], dtype=np.uint32))
+        start_pass = int(ts["pass"])
+        cursor = 0 if mode == "pass" else int(ts["pass_cursor"])
+        logger.info(
+            "resume: restored step %d (pass %d, batch cursor %d) — "
+            "continuing the fixed-seed trajectory", self._step_count,
+            start_pass, cursor)
+        return start_pass, cursor
+
     # -- checkpoint/resume (pserver doCheckpoint + ParamUtil parity) --------
     def save_checkpoint(self, directory, pass_id=0, keep=3,
-                        coordinator=None):
+                        coordinator=None, resume_at=None):
         """Durable checkpoint of parameters + optimizer state. With a
         ``coordinator`` client, participates in the save election so exactly
-        one worker writes (reference: RequestSaveModel)."""
+        one worker writes (reference: RequestSaveModel).
+
+        ``resume_at=(pass, cursor)`` embeds the trainer_state block a
+        deterministic ``train(resume=True)`` needs — e.g. an EndPass
+        handler saving pass ``p`` passes ``(p + 1, 0)``, the position the
+        next batch would come from."""
         from paddle_tpu.distributed import checkpoint as ckpt
 
         if coordinator is not None and not coordinator.request_save_model():
@@ -840,9 +1119,15 @@ class SGD:
         opt_state = self._opt_state
         if getattr(self, "_pool", None) is not None:
             opt_state = self._pool.unpool_state(jax.device_get(opt_state))
+        extra = None
+        if resume_at is not None:
+            extra = {"trainer_state": ckpt.trainer_state_meta(
+                jax.device_get(self._rng), resume_at[0], resume_at[1],
+                self._step_count)}
         return ckpt.save_checkpoint(
             directory, self.parameters, opt_state=jax.device_get(opt_state),
-            step=self._step_count, pass_id=pass_id, keep=keep)
+            step=self._step_count, pass_id=pass_id, keep=keep,
+            extra_meta=extra)
 
     def restore_checkpoint(self, directory_or_path):
         """Resume parameters + optimizer state from the newest valid

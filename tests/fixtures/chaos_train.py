@@ -1,0 +1,109 @@
+"""Fixed-seed training child for the kill -9 chaos gate
+(tests/test_preemption.py).
+
+Trains a tiny fixed-seed classifier with periodic checkpoints and
+prints one flushed line per finalized step::
+
+    LOSS <pass> <batch> <%.17g cost>
+
+plus ``CKPT <step>`` whenever the async writer commits a checkpoint
+(polled via ``AsyncCheckpointer.last_committed()``), so the parent test
+can SIGKILL this process at a point where a durable checkpoint is known
+to exist. Run with ``--resume`` to continue from the newest valid
+checkpoint — the chaos gate asserts the combined LOSS stream is
+identical to an uninterrupted run's.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def build_trainer():
+    import paddle_tpu as paddle
+    from paddle_tpu import data_type as dt, layer as L
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.parameters import Parameters
+
+    # stable auto-names across processes (checkpoint name match), and
+    # Momentum so resume correctness depends on restored SLOTS too
+    reset_name_counters()
+    x = L.data(name="x", type=dt.dense_vector(4))
+    lab = L.data(name="y", type=dt.integer_value(2))
+    cost = L.classification_cost(input=L.fc(input=x, size=2), label=lab)
+    params = Parameters.create(cost)
+    return paddle.trainer.SGD(
+        cost, params, opt.Momentum(momentum=0.9, learning_rate=0.1))
+
+
+def reader_factory(batches, batch_size):
+    def reader():
+        rng = np.random.RandomState(0)
+        W = rng.randn(4, 2)
+        for _ in range(batches * batch_size):
+            x = rng.randn(4).astype(np.float32)
+            yield x, int(np.argmax(x @ W))
+
+    return reader
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint-dir", required=True)
+    ap.add_argument("--checkpoint-every", type=int, default=4)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--sync", action="store_true")
+    ap.add_argument("--num-passes", type=int, default=3)
+    ap.add_argument("--batches", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--pace", type=float, default=0.0,
+                    help="sleep per step: on an idle box the tiny model "
+                         "outruns the ckpt-writer's fsync, so the first "
+                         "COMMIT would land at the very end and the "
+                         "parent's kill window never opens; pacing keeps "
+                         "commits interleaved with steps (the math is "
+                         "time-independent, so the trajectory identity "
+                         "is untouched)")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu import minibatch
+
+    trainer = build_trainer()
+    seen_ckpt = {"step": None}
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            print("LOSS %d %d %.17g" % (e.pass_id, e.batch_id, e.cost),
+                  flush=True)
+            if args.pace:
+                import time
+
+                time.sleep(args.pace)
+            writer = trainer._ckpt_writer
+            if writer is not None:
+                _, step = writer.last_committed()
+                if step is not None and step != seen_ckpt["step"]:
+                    seen_ckpt["step"] = step
+                    print("CKPT %d" % step, flush=True)
+
+    trainer.train(
+        minibatch.batch(reader_factory(args.batches, args.batch_size),
+                        args.batch_size),
+        num_passes=args.num_passes, event_handler=handler,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_sync=args.sync, resume=args.resume)
+    print("DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,11 +64,22 @@ def config_file(tmp_path_factory):
 
 def test_cli_train_and_checkpoint(config_file, tmp_path):
     save_dir = str(tmp_path / "ckpts")
+    ckpt_dir = str(tmp_path / "step_ckpts")
     proc = _run_cli(["train", "--config", config_file, "--num-passes", "2",
-                     "--save-dir", save_dir])
+                     "--save-dir", save_dir,
+                     "--checkpoint-dir", ckpt_dir,
+                     "--checkpoint-every", "4"])
     assert proc.returncode == 0, proc.stderr
     assert "test cost=" in proc.stdout
     assert any(d.startswith("pass-") for d in os.listdir(save_dir))
+    # step-cadence checkpoints (async overlapped writer) committed too
+    assert any(d.startswith("pass-") for d in os.listdir(ckpt_dir))
+    # --resume restores the newest valid checkpoint and trains on
+    proc = _run_cli(["train", "--config", config_file, "--num-passes", "2",
+                     "--checkpoint-dir", ckpt_dir,
+                     "--checkpoint-every", "4", "--resume"])
+    assert proc.returncode == 0, proc.stderr
+    assert "test cost=" in proc.stdout
 
 
 def test_cli_time_job(config_file):

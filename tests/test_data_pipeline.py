@@ -563,3 +563,36 @@ def test_trainer_feed_records_and_summary(tmp_path, monkeypatch):
     with redirect_stdout(buf):
         assert cli.cmd_observe(A()) == 0
     assert "feed stall ms" in buf.getvalue()
+
+def test_feeder_cancel_honored_mid_skip_prefix():
+    """Cancellation while the producer is still consuming the
+    resume-skip prefix (train(resume=) deep into a pass over a slow
+    reader) must stop it promptly — the skip branch converts nothing
+    and never touches the queue, so it needs its own cancellation
+    check or the consumer's cancel+join at abandonment hangs out its
+    timeout and leaks the thread."""
+    import itertools
+    import queue as _queue
+    import threading
+    import time as _time
+
+    cost = _dense_model()
+    topo = Topology(cost)
+
+    def slow_batches():
+        for b in itertools.cycle(_dense_batches(8)):
+            _time.sleep(0.02)  # an endless, slow skipped prefix
+            yield b
+
+    feeder = DeviceFeeder(slow_batches, topo, depth=1,
+                          metrics_registry=observe_metrics.MetricsRegistry())
+    q = _queue.Queue(maxsize=1)
+    cancel = threading.Event()
+    t = threading.Thread(target=feeder._produce,
+                         args=(q, cancel, 10 ** 9),
+                         name="data-feeder-producer", daemon=True)
+    t.start()
+    _time.sleep(0.15)  # well inside the skip prefix
+    cancel.set()
+    t.join(timeout=2.0)
+    assert not t.is_alive()

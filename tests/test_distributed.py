@@ -247,6 +247,374 @@ def test_trainer_checkpoint_resume(tmp_path):
         np.testing.assert_allclose(params2.get(n), params.get(n), rtol=1e-5)
 
 
+def test_verify_checkpoint_reports_failing_file(tmp_path):
+    """Integrity failures name WHAT broke: truncated payload, missing
+    payload, missing manifest (ISSUE 12 satellite)."""
+    cost, params = _make_params()
+    path = ckpt.save_checkpoint(str(tmp_path), params, step=1)
+    ok, reason = ckpt.verify_checkpoint(path)
+    assert ok and reason == "ok"
+    tar = os.path.join(path, "parameters.tar")
+    with open(tar, "r+b") as f:  # torn mid-write by a crash
+        f.truncate(os.path.getsize(tar) // 2)
+    ok, reason = ckpt.verify_checkpoint(path)
+    assert not ok and "parameters.tar" in reason and "sha256" in reason
+    os.remove(tar)
+    ok, reason = ckpt.verify_checkpoint(path)
+    assert not ok and "parameters.tar missing" in reason
+    os.remove(os.path.join(path, "meta.json"))
+    ok, reason = ckpt.verify_checkpoint(path)
+    assert not ok and "meta.json" in reason
+
+
+def test_truncated_newest_falls_back_to_previous(tmp_path):
+    """latest_checkpoint skips a corrupt newest in favor of the previous
+    good checkpoint (and load_checkpoint refuses the corrupt one with
+    the failing file in the message)."""
+    cost, params = _make_params()
+    ckpt.save_checkpoint(str(tmp_path), params, step=1)
+    newest = ckpt.save_checkpoint(str(tmp_path), params, step=2)
+    tar = os.path.join(newest, "parameters.tar")
+    with open(tar, "r+b") as f:
+        f.truncate(os.path.getsize(tar) // 2)
+    good = ckpt.latest_checkpoint(str(tmp_path))
+    assert good is not None and good.endswith("step-00000001")
+    with pytest.raises(Exception, match="parameters.tar"):
+        ckpt.load_checkpoint(newest)
+
+
+def test_half_written_tmp_dir_ignored_and_swept(tmp_path):
+    """A .ckpt-tmp-* dir stranded by a kill -9 mid-save is never
+    offered as a checkpoint, and an old-enough one is swept by the next
+    save's prune pass."""
+    cost, params = _make_params()
+    good = ckpt.save_checkpoint(str(tmp_path), params, step=1)
+    stranded = tmp_path / ".ckpt-tmp-crashed"
+    stranded.mkdir()
+    (stranded / "parameters.tar").write_bytes(b"torn")
+    assert ckpt.latest_checkpoint(str(tmp_path)) == good
+    # fresh tmp dirs survive (an in-flight save owns them) ...
+    ckpt.save_checkpoint(str(tmp_path), params, step=2)
+    assert stranded.exists()
+    # ... but one older than any live save is garbage
+    old = time.time() - 2 * ckpt._STALE_TMP_SECS
+    os.utime(str(stranded), (old, old))
+    ckpt.save_checkpoint(str(tmp_path), params, step=3)
+    assert not stranded.exists()
+
+
+def test_client_backoff_survives_coordinator_restart(tmp_path):
+    """Capped-exponential-backoff retry on the RPC plane: a coordinator
+    restart (its own snapshot/recover path) is invisible to workers —
+    the call issued while it is down just takes longer."""
+    import threading
+
+    snap = str(tmp_path / "snap.json")
+    port, proc = spawn_coordinator_on_free_port(snapshot_path=snap)
+    respawned = []
+    try:
+        client = CoordinatorClient("127.0.0.1:%d" % port, worker_id="w0",
+                                   retry_timeout=60.0)
+        client.set_dataset(["a", "b"], chunks_per_task=1)
+        time.sleep(0.5)  # let the dirty snapshot flush
+        proc.kill()
+        proc.wait()
+
+        def respawn():
+            respawned.append(spawn_coordinator(port, snapshot_path=snap))
+
+        t = threading.Timer(1.0, respawn)
+        t.start()
+        try:
+            # issued while the coordinator is DOWN: must ride the backoff
+            # across the restart instead of raising
+            status = client.status()
+        finally:
+            t.join()
+        assert status["todo"] == 2
+    finally:
+        for p in [proc] + respawned:
+            p.kill()
+            p.wait()
+
+
+# ---------------------------------------------------------------------------
+# elastic membership (distributed/elastic.py)
+# ---------------------------------------------------------------------------
+def test_deal_shards_deterministic_and_covering():
+    from paddle_tpu.distributed import elastic
+
+    chunks = ["s%d" % i for i in range(7)]
+    workers = ["w2", "w0", "w1"]
+    deals = [elastic.deal_shards(chunks, workers, w) for w in sorted(workers)]
+    # covers every chunk exactly once, independent of input order
+    assert sorted(c for d in deals for c in d) == sorted(chunks)
+    # pure function: a survivor set re-deals identically everywhere
+    assert elastic.deal_shards(chunks, ["w0", "w2"], "w2") == \
+        elastic.deal_shards(list(reversed(chunks)), ["w2", "w0"], "w2")
+
+
+@pytest.mark.parametrize("lost_kind", ["peer", "self"])
+def test_reform_abort_discards_pending_snapshot(tmp_path, monkeypatch,
+                                                lost_kind):
+    """A reform abort (a peer's WorkerLost OR this worker's own
+    SelfLeaseLost) must NOT commit the pending snapshot during train()'s
+    unwind: each worker stops at its OWN step boundary, so an unwind
+    commit would advance the shared directory's rewind target
+    differently per worker — and a self-lapsed worker's snapshot is
+    from the abandoned pre-reform branch. The write already in flight
+    still completes (atomic + verified)."""
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu import minibatch, optimizer as opt
+    from paddle_tpu.distributed import elastic
+
+    gate = threading.Event()
+    started = threading.Event()
+    orig_write = ckpt.AsyncCheckpointer._write
+
+    def slow_write(self, job):
+        started.set()
+        orig_write(self, job)
+        # hold the writer so later snapshots stay pending until WELL
+        # past the abort; the bounded wait (never released by the
+        # handler — a release before the unwind's discard_pending would
+        # let the writer grab the pending snapshot first) expires under
+        # close()'s join, after the discard already ran
+        gate.wait(3.0)
+
+    monkeypatch.setattr(ckpt.AsyncCheckpointer, "_write", slow_write)
+
+    cost, params = _make_params()
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Momentum(momentum=0.9,
+                                              learning_rate=0.1))
+
+    def samples():
+        rng = np.random.RandomState(3)
+        for _ in range(48):
+            x = rng.randn(4).astype(np.float32)
+            yield x, int(x.sum() > 0)
+
+    seen = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            seen.append(e.batch_id)
+            if len(seen) == 1:
+                # a loaded box may not schedule the writer thread during
+                # the first fast steps: wait here, while the pending
+                # snapshot can only be an early one, until the writer
+                # has STARTED a write — otherwise the abort's discard
+                # could drop the only snapshot ever submitted
+                assert started.wait(10.0), "writer never started"
+            if len(seen) == 4:
+                if lost_kind == "peer":
+                    raise elastic.WorkerLost(["w-dead"], ["w-me"])
+                raise elastic.SelfLeaseLost("w-me: own lease lapsed")
+
+    d = str(tmp_path / "ck")
+    with pytest.raises((elastic.WorkerLost, elastic.SelfLeaseLost)):
+        trainer.train(minibatch.batch(samples, 8), num_passes=1,
+                      event_handler=handler, checkpoint_dir=d,
+                      checkpoint_every=1)
+    # the snapshot the held writer had already started is the only
+    # commit; the pending one at the abort boundary was discarded
+    # (without discard_pending, close() would drain and commit it)
+    names = sorted(n for n in os.listdir(d) if n.startswith("pass-"))
+    abort_step = len(seen) + 1  # cadence submit runs one dispatch ahead
+    assert len(names) == 1, names
+    assert names[0] != "pass-00000-step-%08d" % abort_step, names
+
+
+def test_settled_checkpoint_waits_for_inflight_commit(monkeypatch):
+    """settled_checkpoint returns only once two consecutive polls
+    agree: a commit landing mid-poll (a slower survivor's in-flight
+    write) is picked up instead of raced. Scripted polls, no wall-clock
+    dependence."""
+    from paddle_tpu.distributed import elastic
+
+    views = iter(["step-1", "step-2", "step-2"])
+    polls = []
+
+    def scripted_latest(directory):
+        polls.append(directory)
+        return next(views)
+
+    monkeypatch.setattr(ckpt, "latest_checkpoint", scripted_latest)
+    settled = elastic.settled_checkpoint("dir", poll_secs=0.05, timeout=10.0)
+    assert settled == "step-2"
+    assert len(polls) == 3  # step-1 vs step-2 disagreed; step-2 repeated
+
+
+def test_replacement_commit_retries_past_concurrent_adoption(
+        tmp_path, monkeypatch):
+    """save_checkpoint's same-name replacement must not bless its OWN
+    aside-moved stale dir when a concurrent latest_checkpoint scan
+    adopts it back between the two renames: the resurrected dir
+    verifies (it was a good checkpoint), but accepting it would
+    silently drop the NEW snapshot in favor of pre-reform state. The
+    writer detects the resurrection by meta hash and retries."""
+    import json
+
+    _, params = _make_params()
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, params, step=5, pass_id=0,
+                         extra_meta={"gen": "old"})
+
+    real_rename = os.rename
+    fired = []
+
+    def racing_rename(src, dst):
+        if (not fired and os.path.basename(dst).startswith("pass-")
+                and os.path.basename(src).startswith(".ckpt-tmp-")):
+            fired.append(True)
+            asides = [n for n in os.listdir(d)
+                      if n.startswith(".ckpt-old-")]
+            assert asides  # the writer's aside-move already happened
+            # the concurrent adopter wins the window between the renames
+            real_rename(os.path.join(d, asides[0]), dst)
+            raise OSError(39, "Directory not empty")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", racing_rename)
+    path = ckpt.save_checkpoint(d, params, step=5, pass_id=0,
+                                extra_meta={"gen": "new"})
+    with open(os.path.join(path, "meta.json")) as f:
+        assert json.load(f)["extra"]["gen"] == "new"
+    ok, reason = ckpt.verify_checkpoint(path)
+    assert ok, reason
+    # no stale debris: the re-asided old dir was swept after the commit
+    assert not [n for n in os.listdir(d) if n.startswith(".ckpt-old-")]
+
+
+def test_prune_ages_asides_by_encoded_move_time_not_mtime(tmp_path):
+    """os.rename preserves the directory's own mtime (the ORIGINAL
+    commit's), so an aside of an hour-old checkpoint must not be swept
+    the instant it is created — _prune ages .ckpt-old-* by the move
+    time encoded in the name. An aside whose encoded move time really
+    is ancient still gets swept."""
+    d = str(tmp_path / "ck")
+    os.makedirs(d)
+    fresh = ".ckpt-old-pass-00000-step-00000005-123-%d" % time.time_ns()
+    ancient = ".ckpt-old-pass-00000-step-00000003-123-%d" % (
+        time.time_ns() - int(2 * 3600 * 1e9))
+    hours_ago = time.time() - 2 * 3600
+    for name in (fresh, ancient):
+        p = os.path.join(d, name)
+        os.makedirs(p)
+        os.utime(p, (hours_ago, hours_ago))  # the original commit's mtime
+    ckpt._prune(d, 3)
+    names = set(os.listdir(d))
+    assert fresh in names, "freshly-moved aside swept by its old mtime"
+    assert ancient not in names
+
+
+def test_membership_watch_routes_self_loss_to_self_lease_lost():
+    """A worker whose OWN lease the coordinator already expired must get
+    SelfLeaseLost from the watch, not WorkerLost: absorbing it into a
+    reform would deal this worker back IN while the survivors dealt it
+    OUT (double-trained shards). Peer losses still raise WorkerLost."""
+    from paddle_tpu.distributed import elastic
+
+    class Stub:
+        worker_id = "w0"
+
+        def __init__(self, view):
+            self._view = view
+
+        def workers(self):
+            return list(self._view)
+
+    watch = elastic.MembershipWatch(Stub(["w0"]), ["w0", "w1"],
+                                    poll_secs=0.0)
+    with pytest.raises(elastic.WorkerLost) as ei:
+        watch.check()
+    assert ei.value.lost == ["w1"]
+
+    watch = elastic.MembershipWatch(Stub(["w1"]), ["w0", "w1"],
+                                    poll_secs=0.0)
+    with pytest.raises(elastic.SelfLeaseLost):
+        watch.check()
+
+
+def test_heartbeat_thread_keeps_lease(coordinator):
+    from paddle_tpu.distributed import elastic
+
+    endpoint, _, _ = coordinator
+    probe = CoordinatorClient(endpoint, worker_id="probe")
+    hb = elastic.HeartbeatThread(endpoint, "hb-w", ttl=0.6).start()
+    try:
+        time.sleep(1.5)  # well past ttl: only renewals keep the lease
+        assert "hb-w" in probe.workers()
+        assert hb.stats()["beats"] >= 1
+    finally:
+        hb.stop()
+    time.sleep(1.0)  # stopped: the lease lapses like a crashed worker's
+    assert "hb-w" not in probe.workers()
+
+
+def test_elastic_lost_worker_rewinds_and_redeals(coordinator, tmp_path):
+    """The lost-worker tentpole, single-survivor shape: a peer's lease
+    lapses mid-pass; the survivor detects it at the next step boundary,
+    rewinds to the last committed checkpoint, re-deals the dead worker's
+    shards to itself deterministically and finishes the pass over ALL
+    data."""
+    import paddle_tpu as paddle
+    from paddle_tpu import minibatch, optimizer as opt
+    from paddle_tpu.distributed import elastic
+
+    endpoint, _, _ = coordinator
+    # w1 heartbeats normally until the chaos point mid-pass: a bare
+    # register with a short ttl could lapse during w0's SETUP (the
+    # baseline checkpoint + membership settle are load-dependent), which
+    # would make the first deal single-worker and the test vacuous
+    doomed = elastic.HeartbeatThread(endpoint, "w1", ttl=1.2).start()
+
+    cost, params = _make_params()
+    trainer = paddle.trainer.SGD(cost, params,
+                                 opt.Momentum(momentum=0.9,
+                                              learning_rate=0.1))
+    chunks = ["s%d" % i for i in range(4)]
+    consumed = []  # (epoch, shard) of every shard actually trained
+    epoch = [0]
+    slept = []
+
+    def reader_of(shards):
+        epoch[0] += 1
+
+        def samples():
+            rng = np.random.RandomState(7)
+            W = rng.randn(4, 2)
+            for shard in shards:
+                consumed.append((epoch[0], shard))
+                if shard == "s2" and not slept:
+                    # w1 "dies" here; shard IO slow enough for its
+                    # lease to lapse mid-pass
+                    slept.append(True)
+                    doomed.stop()
+                    time.sleep(1.6)
+                for _ in range(16):
+                    x = rng.randn(4).astype(np.float32)
+                    yield x, int(np.argmax(x @ W))
+
+        return minibatch.batch(samples, 8)
+
+    stats = elastic.run_elastic(
+        trainer, endpoint, chunks, reader_of, str(tmp_path / "ck"),
+        num_passes=1, checkpoint_every=1, checkpoint_sync=True,
+        worker_id="w0", heartbeat_ttl=30.0, poll_secs=0.05)
+
+    assert stats["reforms"] == 1
+    assert stats["lost"] == ["w1"]
+    # epoch 1: the 2-worker deal; epoch 2: the survivor owns everything
+    assert stats["deals"][0] == ["s0", "s2"]
+    assert stats["deals"][1] == chunks
+    assert [s for e, s in consumed if e == 2] == chunks
+    assert ckpt.latest_checkpoint(str(tmp_path / "ck")) is not None
+
+
 def test_snapshot_recovery_hostile_task_names(coordinator, tmp_path):
     """Wire-format hardening (VERDICT r1 item 10): chunk names containing
     quotes, backslashes, JSON structure characters, control chars and
@@ -293,3 +661,218 @@ def test_snapshot_recovery_hostile_task_names(coordinator, tmp_path):
     finally:
         proc2.kill()
         proc2.wait()
+
+
+def test_verify_checkpoint_non_mapping_manifest(tmp_path):
+    """A meta.json that parses as JSON but whose ``files`` is not a
+    mapping is a corrupt checkpoint, not a crash: verify reports it and
+    latest_checkpoint falls back to the previous good one."""
+    import json
+
+    cost, params = _make_params()
+    good = ckpt.save_checkpoint(str(tmp_path), params, step=1)
+    bad = ckpt.save_checkpoint(str(tmp_path), params, step=2)
+    meta_path = os.path.join(bad, "meta.json")
+    meta = json.load(open(meta_path))
+    meta["files"] = "not-a-mapping"
+    json.dump(meta, open(meta_path, "w"))
+    ok, reason = ckpt.verify_checkpoint(bad)
+    assert not ok and "manifest" in reason
+    assert ckpt.latest_checkpoint(str(tmp_path)) == good
+
+
+def test_writer_error_surfaces_even_inside_except_block(tmp_path,
+                                                        monkeypatch):
+    """A ckpt-writer failure must fail the train() call that owns it —
+    including when that call runs inside an ``except`` handler, where
+    sys.exc_info() reports the OUTER handled exception (the natural
+    retry-with-resume pattern) even though train() itself completes."""
+    import paddle_tpu as paddle
+    from paddle_tpu import minibatch, optimizer as opt
+
+    cost, params = _make_params()
+    trainer = paddle.trainer.SGD(
+        cost, params, opt.Momentum(momentum=0.9, learning_rate=0.1))
+
+    def boom(*args, **kwargs):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt, "save_checkpoint", boom)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(4):  # ONE step: the error surfaces at close()
+            x = rng.randn(4).astype(np.float32)
+            yield x, 1
+
+    with pytest.raises(OSError, match="disk full"):
+        try:
+            raise ValueError("outer handled failure")
+        except ValueError:
+            trainer.train(minibatch.batch(reader, 4), num_passes=1,
+                          checkpoint_dir=str(tmp_path / "ck"),
+                          checkpoint_every=1)
+
+
+def test_elastic_reform_before_first_commit_has_rewind_target(
+        coordinator, tmp_path):
+    """A peer lost before any cadence save ever committed must still
+    rewind deterministically: run_elastic commits a step-0 baseline
+    before the first step, so survivors never keep dirty in-memory
+    state."""
+    import paddle_tpu as paddle
+    from paddle_tpu import minibatch, optimizer as opt
+    from paddle_tpu.distributed import elastic
+
+    endpoint, _, _ = coordinator
+    # heartbeats until the chaos point, like the lost-worker test: a
+    # bare short-ttl register could lapse during w0's setup, before the
+    # two-worker deal this test needs even forms
+    doomed = elastic.HeartbeatThread(endpoint, "w1", ttl=1.2).start()
+
+    cost, params = _make_params()
+    trainer = paddle.trainer.SGD(
+        cost, params, opt.Momentum(momentum=0.9, learning_rate=0.1))
+    chunks = ["s%d" % i for i in range(4)]
+    epoch = [0]
+    slept = []
+
+    def reader_of(shards):
+        epoch[0] += 1
+
+        def samples():
+            rng = np.random.RandomState(7)
+            W = rng.randn(4, 2)
+            for shard in shards:
+                if not slept:
+                    slept.append(True)
+                    doomed.stop()
+                    time.sleep(1.6)  # w1's lease lapses before step 1
+                for _ in range(8):
+                    x = rng.randn(4).astype(np.float32)
+                    yield x, int(np.argmax(x @ W))
+
+        return minibatch.batch(samples, 8)
+
+    ck_dir = str(tmp_path / "ck")
+    stats = elastic.run_elastic(
+        trainer, endpoint, chunks, reader_of, ck_dir,
+        num_passes=1, checkpoint_every=1000, checkpoint_sync=True,
+        worker_id="w0", heartbeat_ttl=30.0, poll_secs=0.05)
+
+    assert stats["reforms"] == 1 and stats["lost"] == ["w1"]
+    # the only committed checkpoint is the step-0 baseline — and it was
+    # a valid rewind target for the reform
+    latest = ckpt.latest_checkpoint(ck_dir)
+    assert latest is not None and latest.endswith("step-00000000")
+    assert stats["deals"][1] == chunks  # survivor re-dealt everything
+
+
+def test_save_checkpoint_accepts_lost_rename_race(tmp_path, monkeypatch):
+    """Two elastic workers committing the same checkpoint name to a
+    shared dir: the rename loser accepts the winner's equivalent commit
+    instead of crashing — unless what won doesn't verify."""
+    import shutil
+
+    cost, params = _make_params()
+    # a stashed "winner" commit to plant mid-race
+    winner_src = ckpt.save_checkpoint(str(tmp_path / "w"), params, step=3)
+    shared = tmp_path / "shared"
+    final = str(shared / os.path.basename(winner_src))
+    real_rename = os.rename
+
+    def racing_rename(src, dst):
+        if dst == final:  # the winner commits first; we lose the race
+            shutil.copytree(winner_src, final)
+            raise OSError(39, "Directory not empty", dst)
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", racing_rename)
+    path = ckpt.save_checkpoint(str(shared), params, step=3)
+    assert path == final and ckpt.verify_checkpoint(path)[0]
+    assert not [d for d in os.listdir(str(shared))
+                if d.startswith(".ckpt-tmp-")]  # loser's tmp cleaned up
+
+    # a torn winner is NOT accepted: the loser's failure surfaces
+    os.remove(os.path.join(final, "meta.json"))
+
+    def racing_rename_torn(src, dst):
+        if dst == final:
+            raise OSError(39, "Directory not empty", dst)
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", racing_rename_torn)
+    with pytest.raises(OSError):
+        ckpt.save_checkpoint(str(shared), params, step=3)
+
+
+def test_same_name_replace_has_no_destroy_window(tmp_path):
+    """Re-committing an existing checkpoint name (reform rewound and
+    re-trained to the same step) replaces content WITHOUT an rmtree
+    window, and leaves no aside/tmp debris behind."""
+    cost, params = _make_params()
+    first = ckpt.save_checkpoint(str(tmp_path), params, step=5)
+    old_w = params.get("__fc_layer_0__.w0").copy()
+    params.set("__fc_layer_0__.w0", old_w + 1.0)
+    second = ckpt.save_checkpoint(str(tmp_path), params, step=5)
+    assert second == first
+    p2, _, _ = ckpt.load_checkpoint(second)
+    np.testing.assert_allclose(p2.get("__fc_layer_0__.w0"), old_w + 1.0)
+    debris = [d for d in os.listdir(str(tmp_path))
+              if d.startswith(".ckpt-")]
+    assert not debris
+    # a stranded aside dir (killed mid-replace) is swept once stale
+    stranded = tmp_path / ".ckpt-old-pass-00000-step-00000005-1-2"
+    stranded.mkdir()
+    old = time.time() - 2 * ckpt._STALE_TMP_SECS
+    os.utime(str(stranded), (old, old))
+    ckpt.save_checkpoint(str(tmp_path), params, step=6)
+    assert not stranded.exists()
+
+
+def test_heartbeat_self_lapse_detected(coordinator):
+    """A worker partitioned from the coordinator longer than ttl knows
+    its own lease lapsed (peers re-dealt around it) instead of silently
+    rejoining on the next successful heartbeat."""
+    from paddle_tpu.distributed import elastic
+
+    endpoint, _, proc = coordinator
+    hb = elastic.HeartbeatThread(endpoint, "w-self", ttl=0.6).start()
+    try:
+        time.sleep(0.3)
+        assert not hb.lease_lapsed()
+        proc.kill()  # the "partition"
+        proc.wait()
+        time.sleep(1.2)
+        assert hb.lease_lapsed()
+    finally:
+        hb.stop()
+
+
+def test_settled_members_waits_for_expected(coordinator):
+    """The first deal of a fixed-size launch waits for every expected
+    worker to register, so an early starter doesn't deal itself chunks
+    a late registrant also gets."""
+    import threading
+
+    from paddle_tpu.distributed import elastic
+
+    endpoint, _, _ = coordinator
+    c0 = CoordinatorClient(endpoint, worker_id="w0")
+    c0.register(ttl=30.0)
+
+    def late_join():
+        time.sleep(0.4)
+        c1 = CoordinatorClient(endpoint, worker_id="w1")
+        c1.register(ttl=30.0)
+        c1.close()
+
+    t = threading.Thread(target=late_join, name="late-join")
+    t.start()
+    try:
+        members = elastic.settled_members(c0, poll_secs=0.1, expected=2,
+                                          timeout=5.0)
+        assert members == {"w0", "w1"}
+    finally:
+        t.join()
+        c0.close()

@@ -426,6 +426,9 @@ def _full_featured_log(tmp_path):
         slog.write({"type": "bench_row", "metric": "x", "value": 1.0})
         slog.log_feed(step=2, stall_ms=0.8, convert_ms=1.1, examples=64,
                       depth=2, bucket=32, fill_tokens=100, pad_tokens=28)
+        slog.log_checkpoint(step=2, duration_ms=3.25, nbytes=4096,
+                            overlapped=True, step_thread_ms=0.12,
+                            pass_id=0, path="pass-00000-step-00000002")
         slog.log_serve_request(rows=1, queue_ms=0.5, latency_ms=2.5,
                                req_id=1)
         slog.log_serve_batch(rows=3, bucket=4, infer_ms=1.2, batch_id=1,

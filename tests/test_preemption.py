@@ -1,0 +1,298 @@
+"""Preemption-tolerance gates (ISSUE 12, docs/distributed.md).
+
+* kill -9 chaos: a training subprocess is SIGKILLed mid-run after a
+  checkpoint committed; the resumed run's per-step loss stream must be
+  IDENTICAL (<= 1e-6) to an uninterrupted fixed-seed run's — reader
+  position, rng and optimizer slots included.
+* resume determinism matrix: the same identity across every loop shape
+  (plain / pipelined feed / fused steps_per_call / blocking saves),
+  in-process.
+* corrupted-checkpoint fallback: a torn newest checkpoint is skipped in
+  favor of the previous good one, and the resumed trajectory is still
+  exact.
+
+Reference: the pserver's MD5-checked checkpoint + recoverable task
+leases existed for exactly this scenario (PAPER.md SURVEY "Cloud-native
+Go runtime"); test style follows go/pserver service_test.go's
+checkpoint round-trips, escalated to a real kill -9.
+"""
+
+import os
+import selectors
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS = os.path.join(REPO, "tests", "fixtures", "chaos_train.py")
+
+
+# ---------------------------------------------------------------------------
+# in-process resume determinism matrix
+# ---------------------------------------------------------------------------
+def _make_trainer():
+    import paddle_tpu as paddle
+    from paddle_tpu import data_type as dt, layer as L
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.parameters import Parameters
+
+    reset_name_counters()
+    x = L.data(name="x", type=dt.dense_vector(4))
+    lab = L.data(name="y", type=dt.integer_value(2))
+    cost = L.classification_cost(input=L.fc(input=x, size=2), label=lab)
+    params = Parameters.create(cost)
+    return paddle.trainer.SGD(
+        cost, params, opt.Momentum(momentum=0.9, learning_rate=0.1))
+
+
+def _reader():
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 2)
+    for _ in range(240):
+        x = rng.randn(4).astype(np.float32)
+        yield x, int(np.argmax(x @ W))
+
+
+class _Abort(Exception):
+    pass
+
+
+def _run(ckpt_dir=None, every=0, resume=False, abort_after=None,
+         passes=3, sync=False, pipeline=False, spc=None):
+    """One fixed-seed run; returns {(pass, batch): loss}."""
+    import paddle_tpu as paddle
+    from paddle_tpu import minibatch
+
+    trainer = _make_trainer()
+    losses = {}
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            losses[(e.pass_id, e.batch_id)] = float(e.cost)
+            if abort_after is not None and len(losses) >= abort_after:
+                raise _Abort()
+
+    try:
+        trainer.train(minibatch.batch(lambda: _reader(), 20),
+                      num_passes=passes, event_handler=handler,
+                      checkpoint_dir=ckpt_dir or None,
+                      checkpoint_every=every, resume=resume,
+                      checkpoint_sync=sync, feed_pipeline=pipeline,
+                      steps_per_call=spc)
+    except _Abort:
+        pass
+    return losses
+
+
+def _assert_resumed_identical(base, part, res, tag):
+    """part (interrupted prefix) and res (resumed stream) must tile the
+    baseline: every reported key matches <= 1e-6, the resume point is
+    past the start, and any unreported key sits in the one-deep
+    pipeline's finalize gap (dispatched + checkpointed, never printed)."""
+    assert res, "%s: resumed run reported nothing" % tag
+    first_res = min(res)
+    assert first_res > min(base), (tag, first_res)
+    for key, val in part.items():
+        assert abs(val - base[key]) <= 1e-6, (tag, "prefix", key)
+    for key, val in res.items():
+        assert key in base, (tag, "resumed key not in baseline", key)
+        assert abs(val - base[key]) <= 1e-6, (
+            tag, "resume diverged", key, val, base[key])
+    missing = set(base) - set(part) - set(res)
+    assert all(max(part) < k < first_res for k in missing), (
+        tag, "missing steps", sorted(missing)[:5], first_res)
+
+
+def test_resume_identical_trajectory_matrix(tmp_path):
+    """checkpoint_every + resume continues the IDENTICAL fixed-seed
+    trajectory under every loop shape; the baseline runs WITHOUT
+    checkpointing, so the same assert also proves overlapped snapshots
+    never perturb the math."""
+    base = _run()
+    assert len(base) == 36
+    for tag, kw in [("plain", {}), ("pipelined", {"pipeline": True}),
+                    ("fused", {"spc": 2}), ("sync", {"sync": True})]:
+        d = str(tmp_path / tag)
+        part = _run(ckpt_dir=d, every=3, abort_after=8, **kw)
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        assert ckpt.latest_checkpoint(d) is not None, tag
+        res = _run(ckpt_dir=d, every=3, resume=True, **kw)
+        _assert_resumed_identical(base, part, res, tag)
+
+
+def test_resume_at_pass_boundary_skips_completed_pass(tmp_path):
+    """A checkpoint whose cursor sits exactly at the pass boundary
+    (checkpoint_every divides the 12-batch pass length) resumes at the
+    NEXT pass under every loop shape: no duplicate BeginPass/EndPass for
+    the already-finished pass (a re-emitted EndPass would read the empty
+    evaluator accumulator as a falsely perfect pass record and re-run
+    the per-pass test), and the trajectory stays exact."""
+    import paddle_tpu as paddle
+    from paddle_tpu import minibatch
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    base = _run()
+    for tag, kw in [("plain", {}), ("pipelined", {"feed_pipeline": True}),
+                    ("fused", {"steps_per_call": 2})]:
+        d = str(tmp_path / tag)
+        # sync saves: the (pass 0, cursor 12) boundary checkpoint commits
+        # deterministically before the abort one batch into pass 1
+        part = _run(ckpt_dir=d, every=12, abort_after=13, sync=True)
+        latest = ckpt.latest_checkpoint(d)
+        assert latest is not None and latest.endswith("step-00000012"), tag
+
+        trainer = _make_trainer()
+        losses, passes = {}, []
+
+        def handler(e):
+            if isinstance(e, paddle.event.EndIteration):
+                losses[(e.pass_id, e.batch_id)] = float(e.cost)
+            elif isinstance(e, paddle.event.BeginPass):
+                passes.append(("begin", e.pass_id))
+            elif isinstance(e, paddle.event.EndPass):
+                passes.append(("end", e.pass_id))
+
+        trainer.train(minibatch.batch(lambda: _reader(), 20), num_passes=3,
+                      event_handler=handler, checkpoint_dir=d,
+                      checkpoint_every=12, resume=True,
+                      checkpoint_sync=True, **kw)
+        assert min(losses) == (1, 0), (tag, min(losses))
+        assert passes == [("begin", 1), ("end", 1),
+                          ("begin", 2), ("end", 2)], (tag, passes)
+        _assert_resumed_identical(base, part, losses, tag)
+
+
+def test_resume_with_missing_dir_trains_from_scratch(tmp_path):
+    """resume=True before the first checkpoint ever committed (first
+    launch of an always-pass---resume launcher, or an elastic reform
+    that beat the first commit): the not-yet-created directory means
+    train-from-scratch, not an integrity error."""
+    d = str(tmp_path / "never_created")
+    losses = _run(ckpt_dir=d, every=50, resume=True, passes=1)
+    assert len(losses) == 12 and (0, 0) in losses
+
+
+def test_corrupt_newest_checkpoint_falls_back(tmp_path):
+    """A truncated newest checkpoint (torn mid-write by a crash) is
+    skipped with the failing file named; resume restores the PREVIOUS
+    good checkpoint and the trajectory stays exact from there."""
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    base = _run()
+    d = str(tmp_path / "ck")
+    part = _run(ckpt_dir=d, every=3, abort_after=8)
+    names = sorted(n for n in os.listdir(d) if n.startswith("pass-"))
+    assert len(names) >= 2, names
+    newest = os.path.join(d, names[-1])
+    tar = os.path.join(newest, "parameters.tar")
+    with open(tar, "r+b") as f:
+        f.truncate(os.path.getsize(tar) // 2)
+    ok, reason = ckpt.verify_checkpoint(newest)
+    assert not ok and "parameters.tar" in reason
+    assert ckpt.latest_checkpoint(d) == os.path.join(d, names[-2])
+    res = _run(ckpt_dir=d, every=3, resume=True)
+    # fell back: the resume point is the PREVIOUS checkpoint's cursor,
+    # so the resumed stream starts earlier than the torn one's step
+    newest_step = int(names[-1].rsplit("-", 1)[1])
+    prev_step = int(names[-2].rsplit("-", 1)[1])
+    resumed_steps = sorted(p * 12 + b + 1 for p, b in res)
+    assert resumed_steps[0] == prev_step + 1
+    assert resumed_steps[0] <= newest_step
+    _assert_resumed_identical(base, {k: part[k] for k in part
+                                     if (k[0] * 12 + k[1] + 1) <= prev_step},
+                              res, "fallback")
+
+
+# ---------------------------------------------------------------------------
+# kill -9 chaos gate (subprocess; CPU)
+# ---------------------------------------------------------------------------
+def _spawn(ckpt_dir, *extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    env.pop("XLA_FLAGS", None)  # 1 CPU device: cheaper than the test mesh
+    return subprocess.Popen(
+        [sys.executable, CHAOS, "--checkpoint-dir", ckpt_dir] + list(extra),
+        stdout=subprocess.PIPE, env=env, cwd=REPO)
+
+
+def _read_run(proc, kill_after=None, timeout=200):
+    """Parse LOSS/CKPT lines from the child. ``kill_after=N`` SIGKILLs
+    it N further LOSS lines after the first committed checkpoint —
+    mid-pass, mid-cadence, with the writer possibly in flight."""
+    losses, ckpt_steps, state = {}, [], {"countdown": None, "killed": False}
+    sel = selectors.DefaultSelector()
+    fd = proc.stdout.fileno()
+    sel.register(fd, selectors.EVENT_READ)
+    deadline = time.time() + timeout
+    buf = b""
+    try:
+        while time.time() < deadline:
+            if not sel.select(timeout=max(0.0, deadline - time.time())):
+                break
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                parts = line.decode(errors="replace").split()
+                if not parts:
+                    continue
+                if parts[0] == "LOSS":
+                    losses[(int(parts[1]), int(parts[2]))] = float(parts[3])
+                    if state["countdown"] is not None:
+                        state["countdown"] -= 1
+                elif parts[0] == "CKPT":
+                    ckpt_steps.append(int(parts[1]))
+                    if kill_after is not None and state["countdown"] is None:
+                        state["countdown"] = kill_after
+                if (state["countdown"] is not None
+                        and state["countdown"] <= 0
+                        and not state["killed"]):
+                    os.kill(proc.pid, signal.SIGKILL)  # no cleanup, no flush
+                    state["killed"] = True
+                    break
+            if state["killed"]:
+                break
+    finally:
+        sel.close()
+        proc.wait(timeout=30)
+        proc.stdout.close()
+    return losses, ckpt_steps, state["killed"]
+
+
+def test_kill9_chaos_resume_identical_trajectory(tmp_path):
+    """The tier-1 chaos gate: SIGKILL a checkpointing training process
+    mid-run; a --resume run must continue the identical fixed-seed
+    trajectory (loss stream == the uninterrupted run's, <= 1e-6),
+    including the reader position and optimizer slots."""
+    base_dir, chaos_dir = str(tmp_path / "base"), str(tmp_path / "chaos")
+
+    base, _, killed = _read_run(_spawn(base_dir))
+    assert not killed and len(base) == 30, len(base)  # 3 passes x 10
+
+    # paced: the tiny model outruns the writer's fsync on an idle box,
+    # which would push the first visible commit past the kill window
+    part, ckpts, killed = _read_run(
+        _spawn(chaos_dir, "--pace", "0.1"), kill_after=2)
+    assert killed, "child finished before the kill window"
+    assert ckpts, "no committed checkpoint before the kill"
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    latest = ckpt.latest_checkpoint(chaos_dir)
+    assert latest is not None  # kill -9 never tears a committed dir
+
+    res, _, _ = _read_run(_spawn(chaos_dir, "--resume"))
+    _assert_resumed_identical(base, part, res, "kill9")
+    # the resumed stream picks up exactly at the newest committed
+    # checkpoint's cursor — no replay, no skip-ahead
+    meta_step = int(os.path.basename(latest).rsplit("-", 1)[1])
+    first = min(res)
+    assert first[0] * 10 + first[1] + 1 == meta_step + 1, (first, meta_step)
