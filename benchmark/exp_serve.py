@@ -49,6 +49,17 @@ audited set via ``observe/regress.py`` (warn-only by default,
   compiles; emits qps rows for both sides plus audited ``bytes`` /
   ``replicas`` capacity rows.
 
+* ``--mode sessions`` — the session-tier A/B (docs/serving.md "Session
+  tier & paging"): ONE fixed-seed think-time trace with sessions >>
+  ``decode_slots`` (each session decodes chunks with think gaps
+  between them) against (a) the hard admission cap, where a live
+  session pins its slot for life and overflow 429s, and (b) the paged
+  session tier spilling quiescent carries to the host store. Gates
+  before any row emits: paging bitwise-correct vs the whole-sequence
+  decode, zero post-warmup compiles, the paged side serves every
+  session, the cap bites on the baseline, and the mean spill
+  device_get stays under the mean window dispatch (the overlap claim).
+
 Usage:
   python benchmark/exp_serve.py                       # closed-loop MLP
   python benchmark/exp_serve.py --mode openloop-ab
@@ -619,6 +630,265 @@ def measure_quant_ab(args):
     return [row_fp, row_q, row_hbm, row_fit]
 
 
+# -- session-tier machinery (--mode sessions) --------------------------------
+
+def session_trace(sessions, chunks_per, mean_len, think_ms, ramp_s, seed,
+                  vocab=1000):
+    """ONE reproducible multi-session conversation load: ``sessions``
+    users, each decoding ``chunks_per`` request chunks of lognormal
+    lengths with exponential think-time gaps between them (the gap
+    counts from the PREVIOUS chunk's completion — a user reads the
+    reply, thinks, types). Session starts stagger uniformly over
+    ``ramp_s`` seconds. The same seed always replays the same trace, so
+    the hard-cap baseline and the paged session tier see identical
+    work."""
+    rng = np.random.RandomState(seed)
+    starts = np.sort(rng.uniform(0.0, ramp_s, size=sessions))
+    chunks, thinks = [], []
+    for _ in range(sessions):
+        lens = np.clip(np.rint(rng.lognormal(np.log(mean_len), 0.6,
+                                             size=chunks_per)),
+                       1, 4 * int(mean_len)).astype(np.int64)
+        chunks.append([rng.randint(0, vocab, size=(int(k),))
+                       .astype(np.int32) for k in lens])
+        thinks.append(rng.exponential(think_ms / 1e3,
+                                      size=chunks_per - 1))
+    return starts, chunks, thinks
+
+
+def drive_session_trace(submit_fn, starts, chunks, thinks,
+                        close_fn=None):
+    """Replay a session trace: chunk 0 of session i is due at
+    ``starts[i]``; chunk c+1 is due at chunk c's completion plus the
+    session's think gap (latency counts from the DUE time, the
+    no-coordinated-omission convention). A shed or gone chunk fails the
+    whole session (its user got an error mid-conversation), skips its
+    remaining chunks and ABORTS the session through ``close_fn`` —
+    exactly what a real front end does, and what keeps a hard-cap
+    baseline from leaking zombie slots to failed sessions. Returns
+    (latencies_ms, completion_times_s, outputs {session: [chunk
+    arrays]}, failed session count)."""
+    import heapq
+
+    from paddle_tpu.serve import Overloaded, SessionGone
+
+    n = len(chunks)
+    total = sum(len(c) for c in chunks)
+    lock = threading.Lock()
+    heap = [(float(starts[i]), i, 0) for i in range(n)]
+    heapq.heapify(heap)
+    latencies, completions = [], []
+    outputs = {i: [] for i in range(n)}
+    failed = set()
+    remaining = [total]
+    done_evt = threading.Event()
+    t0 = time.perf_counter()
+
+    def account(k=1):
+        remaining[0] -= k
+        if remaining[0] <= 0:
+            done_evt.set()
+
+    while True:
+        with lock:
+            if not heap:
+                if remaining[0] <= 0:
+                    break
+                next_due = None
+            else:
+                next_due = heap[0][0]
+        now = time.perf_counter() - t0
+        if next_due is None or next_due > now:
+            if done_evt.wait(timeout=0.002):
+                with lock:
+                    if not heap:
+                        break
+            continue
+        with lock:
+            due, i, c = heapq.heappop(heap)
+        is_last = c == len(chunks[i]) - 1
+        try:
+            fut = submit_fn(i, chunks[i][c], is_last)
+        except (Overloaded, SessionGone):
+            with lock:
+                failed.add(i)
+                account(len(chunks[i]) - c)
+            if close_fn is not None:
+                close_fn(i)
+            continue
+
+        def _done(f, i=i, c=c, due=due, is_last=is_last):
+            t_c = time.perf_counter() - t0
+            try:
+                out = f.result()
+            except Exception:  # noqa: BLE001 — the gate reads `failed`
+                with lock:
+                    failed.add(i)
+                    account(len(chunks[i]) - c)
+                if close_fn is not None:
+                    close_fn(i)
+                return
+            with lock:
+                completions.append(t_c)
+                latencies.append((t_c - due) * 1e3)
+                outputs[i].append(next(iter(out.values())))
+                if not is_last:
+                    gap = float(thinks[i][c])
+                    heapq.heappush(heap, (t_c + gap, i, c + 1))
+                account()
+
+        fut.add_done_callback(_done)
+    return latencies, completions, outputs, len(failed)
+
+
+def measure_sessions(args):
+    """The session-tier acceptance A/B (docs/serving.md "Session tier &
+    paging"): ONE fixed-seed think-time trace with sessions >>
+    decode_slots replayed against (a) the **hard admission cap** — the
+    pre-session scheduler semantic where a live session pins its slot
+    for life (``paging=False``) and everyone past the slots+queue bound
+    is 429'd — and (b) the **paged session tier**, where quiescent
+    sessions spill to the host store and restore on their next chunk.
+
+    Gates asserted BEFORE any row emits:
+
+    1. paging correctness — probe sessions' concatenated chunk outputs
+       match the whole-sequence decode bitwise-level (atol 0);
+    2. zero post-warmup compiles through all paging churn
+       (``watch_compiles``);
+    3. the paged side serves EVERY session (no sheds, no failures);
+    4. the hard cap bites on the same trace (>=1 session shed) —
+       ``--require-cap-bite 0`` relaxes for tiny smoke runs;
+    5. swap overhead: the mean spill device_get (overlapped on the
+       writer thread) is cheaper than the mean window dispatch, so
+       paging rides inside the dispatch the scheduler was already
+       paying."""
+    from paddle_tpu.observe import steplog as observe_steplog
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler, load_bundle
+
+    bundle_dir = args.bundle or _export_tagger_bundle(
+        tempfile.mkdtemp(prefix="serve_tagger_"),
+        tuple(int(b) for b in args.batch_sizes.split(",")),
+        args.seq_len, args.decode_slots, args.decode_window, args.hidden)
+    bundle = load_bundle(bundle_dir)
+    out_name = bundle.outputs[0]["name"]
+    in_name = bundle.inputs[0]["name"]
+    slots = args.decode_slots
+    assert args.sessions > slots, (
+        "--mode sessions wants sessions >> decode_slots (got %d vs %d)"
+        % (args.sessions, slots))
+    starts, chunks, thinks = session_trace(
+        args.sessions, args.chunks_per_session, args.mean_len,
+        args.think_ms, args.session_ramp_s, args.seed)
+
+    # A: the hard admission cap (the slot matrix IS the session table)
+    hard = ContinuousScheduler(
+        bundle, metrics_registry=MetricsRegistry(), model="tagger_hard",
+        paging=False, max_queue=args.hardcap_queue)
+    lat_a, done_a, _, failed_a = drive_session_trace(
+        lambda i, chunk, last: hard.submit(
+            {in_name: chunk}, session_id="s%d" % i, end_session=last),
+        starts, chunks, thinks,
+        close_fn=lambda i: hard.close_session("s%d" % i))
+    hard_stats = hard.stats()
+    hard.stop()
+
+    # B: the paged session tier over the same trace (unbounded queue:
+    # paging, not shedding, is the admission policy under test)
+    paged = ContinuousScheduler(
+        bundle, metrics_registry=MetricsRegistry(), model="tagger_paged",
+        paging=True, max_queue=None,
+        session_capacity=args.session_store,
+        idle_spill_ms=args.idle_spill_ms)
+    with observe_steplog.watch_compiles() as watch:
+        lat_b, done_b, outs_b, failed_b = drive_session_trace(
+            lambda i, chunk, last: paged.submit(
+                {in_name: chunk}, session_id="s%d" % i, end_session=last),
+            starts, chunks, thinks,
+            close_fn=lambda i: paged.close_session("s%d" % i))
+    paged_stats = paged.stats()
+    paged.stop()
+
+    # gate 1: paging correctness — probe sessions bitwise vs the
+    # whole-sequence decode through a fresh sessionless scheduler
+    probe_ids = sorted({0, len(chunks) // 2, len(chunks) - 1})
+    check = ContinuousScheduler(bundle,
+                                metrics_registry=MetricsRegistry(),
+                                model="tagger_check", max_queue=None)
+    for i in probe_ids:
+        whole = check.infer({in_name: np.concatenate(chunks[i])},
+                            timeout=600.0)[out_name]
+        got = np.concatenate(outs_b[i], axis=0)
+        assert got.shape == whole.shape and np.array_equal(got, whole), (
+            "session tier gate FAILED: probe session %d diverges from "
+            "its whole-sequence decode after paging" % i)
+    check.stop()
+    # gate 2: paging churn minted zero post-warmup compiles
+    assert watch.compiles == 0, (
+        "session tier gate FAILED: paging minted %d post-warmup "
+        "compiles: %s" % (watch.compiles, watch.events))
+    # gate 3: the paged side served EVERY session
+    assert failed_b == 0 and paged_stats["shed"] == 0, (
+        "session tier gate FAILED: paged side failed %d sessions, "
+        "shed %d requests" % (failed_b, paged_stats["shed"]))
+    assert paged_stats["spills"] > 0 and paged_stats["restores"] > 0, (
+        "session tier gate FAILED: trace never exercised paging "
+        "(%d spills / %d restores) — raise --sessions or shrink "
+        "--decode-slots" % (paged_stats["spills"],
+                            paged_stats["restores"]))
+    # gate 4: the hard cap actually bit on this trace
+    if args.require_cap_bite:
+        assert failed_a > 0 or hard_stats["shed"] > 0, (
+            "session tier gate FAILED: the hard-cap baseline shed "
+            "nothing — the trace does not exceed the cap; raise "
+            "--sessions or --think-ms")
+    # gate 5: swap overhead < window dispatch time (the overlap claim)
+    spill_ms = (paged_stats.get("spill_get_ms_sum", 0.0)
+                / max(paged_stats["spills"], 1))
+    iter_ms = (paged_stats.get("iter_ms_sum", 0.0)
+               / max(paged_stats["iterations"], 1))
+    assert spill_ms < iter_ms, (
+        "session tier gate FAILED: mean spill device_get %.3fms >= "
+        "mean window dispatch %.3fms — the copy no longer hides "
+        "inside the dispatch" % (spill_ms, iter_ms))
+
+    # the hard cap always serves its slot-resident sessions, so both
+    # sides have completions; an empty side is a broken measurement and
+    # sustained_qps raises on it
+    p50_a, p99_a = _percentiles(lat_a)
+    p50_b, p99_b = _percentiles(lat_b)
+    total_requests = sum(len(c) for c in chunks)
+    base = {
+        "unit": "qps", "sessions": args.sessions, "slots": slots,
+        "window": args.decode_window, "seq_len": args.seq_len,
+        "chunks_per_session": args.chunks_per_session,
+        "think_ms": args.think_ms, "mean_len": args.mean_len,
+        "seed": args.seed, "requests": total_requests,
+        "hidden": args.hidden,
+    }
+    row_a = dict(base, metric="serve_sessions_hardcap_qps",
+                 value=round(sustained_qps(done_a), 2),
+                 p50_ms=p50_a, p99_ms=p99_a,
+                 mode="hard_cap", completed=len(done_a),
+                 sessions_failed=failed_a,
+                 shed=int(hard_stats["shed"]),
+                 max_queue=args.hardcap_queue)
+    row_b = dict(base, metric="serve_sessions_paged_qps",
+                 value=round(sustained_qps(done_b), 2),
+                 p50_ms=p50_b, p99_ms=p99_b,
+                 mode="paged", completed=len(done_b),
+                 sessions_failed=failed_b,
+                 spills=int(paged_stats["spills"]),
+                 restores=int(paged_stats["restores"]),
+                 evictions=int(paged_stats["evictions"]),
+                 spill_get_ms_mean=round(spill_ms, 3),
+                 iter_ms_mean=round(iter_ms, 3),
+                 store_capacity=args.session_store,
+                 serve_compiles=watch.compiles)
+    return [row_a, row_b]
+
+
 def measure_priority(args):
     """The mixed two-model shed run: high-priority MLP at a sustainable
     rate, low-priority MLP flooded, one Router. Only low may shed; the
@@ -749,7 +1019,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", default="closed",
                     choices=("closed", "openloop-ab", "priority",
-                             "replicas-ab", "quant-ab"))
+                             "replicas-ab", "quant-ab", "sessions"))
     ap.add_argument("--bundle", default="",
                     help="pre-exported bundle dir (default: export the "
                          "mode's demo bundle to a tmp dir)")
@@ -816,7 +1086,38 @@ def main(argv=None):
                     help="quant-ab: the reference device-memory budget "
                          "for the replicas-that-fit delta row "
                          "(PADDLE_TPU_HBM_BUDGET syntax)")
+    # session-tier knobs (--mode sessions)
+    ap.add_argument("--sessions", type=int, default=64,
+                    help="sessions mode: concurrent conversations "
+                         "(must exceed --decode-slots — the paging "
+                         "pressure IS the experiment)")
+    ap.add_argument("--chunks-per-session", type=int, default=3,
+                    help="sessions mode: request chunks per "
+                         "conversation")
+    ap.add_argument("--think-ms", type=float, default=200.0,
+                    help="sessions mode: mean think time between a "
+                         "chunk's reply and the next chunk (the "
+                         "quiescence the session tier pages out)")
+    ap.add_argument("--session-ramp-s", type=float, default=0.5,
+                    help="sessions mode: session starts stagger "
+                         "uniformly over this window")
+    ap.add_argument("--hardcap-queue", type=int, default=None,
+                    help="sessions mode: the hard-cap baseline's queue "
+                         "bound (default 2 x decode_slots); past it, "
+                         "429")
+    ap.add_argument("--session-store", type=int, default=4096,
+                    help="sessions mode: paged side's host-store "
+                         "capacity")
+    ap.add_argument("--idle-spill-ms", type=float, default=None,
+                    help="sessions mode: idle-spill threshold (default "
+                         "None = spill under slot pressure only)")
+    ap.add_argument("--require-cap-bite", type=int, default=1,
+                    help="sessions mode gate: the hard-cap side must "
+                         "shed >= 1 session on the trace (0 relaxes "
+                         "for tiny smoke runs)")
     args = ap.parse_args(argv)
+    if args.hardcap_queue is None:
+        args.hardcap_queue = 2 * args.decode_slots
 
     from benchmark.harness import enable_compile_cache
 
@@ -829,6 +1130,8 @@ def main(argv=None):
         return _emit(measure_replicas_ab(args), "exp_serve_replicas")
     if args.mode == "quant-ab":
         return _emit(measure_quant_ab(args), "exp_serve_quant")
+    if args.mode == "sessions":
+        return _emit(measure_sessions(args), "exp_serve_sessions")
     bundle_dir = args.bundle
     if not bundle_dir:
         bundle_dir = _export_demo_bundle(

@@ -99,9 +99,14 @@ HOT_PATHS = {
     "serve/engine.py": {"submit", "_take_batch", "_loop", "_run_batch"},
     "serve/bundle.py": {"run", "infer", "warmup", "decode_step"},
     "serve/scheduler.py": {"submit", "_loop", "_run_iteration",
-                           "_distribute", "_admit"},
+                           "_distribute", "_plan", "_swap_writer_loop"},
+    # the session page file sits on the spill-writer and admission
+    # paths: every put/pop/eviction scan runs per swap under load
+    "serve/sessions.py": {"put", "pop", "touch", "gone_reason",
+                          "_pick_victim_locked", "order"},
     "serve/router.py": {"submit", "total_queued"},
-    "serve/fleet.py": {"submit", "queue_depth", "_eligible"},
+    "serve/fleet.py": {"submit", "queue_depth", "_eligible",
+                       "_route_session"},
     # the quantized-bundle dequant hook is traced INTO every exported
     # program (serve/export.py), so a stray host sync in it would land
     # on every serving dispatch of every quantized bundle

@@ -354,6 +354,17 @@ def cmd_export(args):
     return 0
 
 
+def _session_kwargs(args):
+    """Session-tier knobs of ``cli serve --continuous``
+    (docs/serving.md "Session tier & paging" knob table)."""
+    return {
+        "session_capacity": getattr(args, "session_store", 4096),
+        "idle_spill_ms": getattr(args, "idle_spill_ms", None),
+        "session_slo_grace_ms": getattr(args, "session_slo_ms", None),
+        "session_ttl_ms": getattr(args, "session_ttl_ms", None),
+    }
+
+
 def _make_engine(bundle, args, reg, model=None, warmup="async",
                  budget_share=None):
     from paddle_tpu.serve import ContinuousScheduler, InferenceEngine
@@ -382,7 +393,8 @@ def _make_engine(bundle, args, reg, model=None, warmup="async",
         # fleets cannot jointly overcommit the chip.
         n = (auto_replicas(bundle, budget=budget_share)
              if replicas == "auto" else int(replicas))
-        kwargs = ({"max_queue": args.max_queue_rows} if args.continuous
+        kwargs = (dict({"max_queue": args.max_queue_rows},
+                       **_session_kwargs(args)) if args.continuous
                   else {"max_batch_size": args.max_batch_size,
                         "max_latency_ms": args.max_latency_ms,
                         "max_queue_rows": args.max_queue_rows})
@@ -393,7 +405,7 @@ def _make_engine(bundle, args, reg, model=None, warmup="async",
     if args.continuous:
         return ContinuousScheduler(
             bundle, warmup=warmup, metrics_registry=reg, model=model,
-            max_queue=args.max_queue_rows)
+            max_queue=args.max_queue_rows, **_session_kwargs(args))
     return InferenceEngine(
         bundle, max_batch_size=args.max_batch_size,
         max_latency_ms=args.max_latency_ms, warmup=warmup,
@@ -591,6 +603,19 @@ def cmd_observe(args):
                      ("  qps %.1f" % s["qps"]) if "qps" in s else "",
                      ("  occupancy %.2f" % s["occupancy_mean"])
                      if "occupancy_mean" in s else ""))
+            if "spills" in s or "resident_sessions" in s:
+                # session tier: paging activity + where the sessions sit
+                swaps = ("spills %d restores %d evictions %d"
+                         % (s.get("spills", 0), s.get("restores", 0),
+                            s.get("evictions", 0)))
+                rate = ("  swap/s %.1f" % s["swap_per_s"]
+                        if "swap_per_s" in s else "")
+                counts = ""
+                if "resident_sessions" in s or "suspended_sessions" in s:
+                    counts = ("  sessions resident %d / suspended %d"
+                              % (s.get("resident_sessions", 0),
+                                 s.get("suspended_sessions", 0)))
+                print("      session swaps: %s%s%s" % (swaps, rate, counts))
     if summary["trace_files"]:
         print("  traces (open in https://ui.perfetto.dev): %s"
               % ", ".join(summary["trace_files"]))
@@ -911,6 +936,23 @@ def main(argv=None):
     p.add_argument("--max-queue-rows", type=int, default=None,
                    help="bound each hosted queue; a full queue answers "
                         "429 instead of queueing (load shedding)")
+    p.add_argument("--session-store", type=int, default=4096,
+                   help="session tier (--continuous): host-store "
+                        "capacity in suspended sessions — live "
+                        "sessions page above decode_slots instead of "
+                        "429ing; an evicted session answers 410 Gone "
+                        "(docs/serving.md 'Session tier & paging')")
+    p.add_argument("--idle-spill-ms", type=float, default=None,
+                   help="session tier: spill a parked session's carry "
+                        "to the host store after this much idle time "
+                        "(default: spill only under slot pressure)")
+    p.add_argument("--session-slo-ms", type=float, default=None,
+                   help="session tier: eviction passes over sessions "
+                        "touched within this SLO grace window while "
+                        "any other candidate exists")
+    p.add_argument("--session-ttl-ms", type=float, default=None,
+                   help="session tier: evict suspended sessions idle "
+                        "past this TTL (reason=ttl)")
     p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)

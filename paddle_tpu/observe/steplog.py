@@ -55,7 +55,15 @@ Record types (field ``type``):
   slots), ``window`` (timesteps per dispatch), ``infer_ms``, optional
   ``slots`` (capacity), ``steps`` (real masked-in slot-timesteps),
   ``admitted``/``retired`` (sequences entering/leaving slots this
-  iteration), ``model`` and ``replica`` (fleet member).
+  iteration), ``model`` and ``replica`` (fleet member), and the
+  session tier's ``resident``/``suspended`` counts at dispatch time.
+* ``serve_swap`` — one session-tier paging event
+  (paddle_tpu.serve.scheduler): ``op``
+  (``spill``/``restore``/``evict``/``export``/``import``),
+  ``session``, optional ``bytes`` (carry payload), ``overlap_ms``
+  (the device<->host copy time the next window dispatch absorbed),
+  ``reason`` (evictions: ``capacity``/``ttl``/``error``), ``pos``
+  (absolute decode position), ``model`` and ``replica``.
 * ``serve_shed`` — one request rejected by serving admission control
   (engine queue bound, scheduler queue bound, or the router's
   priority-class shed policy): ``model``, ``reason``
@@ -85,6 +93,7 @@ a record type, fields are only ever added, never renamed (bump
 ``SCHEMA_VERSION`` if that ever has to break).
 """
 
+import collections
 import contextlib
 import json
 import math
@@ -433,10 +442,13 @@ class StepLog:
 
     def log_serve_decode(self, iteration, active, window, infer_ms,
                          slots=None, steps=None, admitted=None,
-                         retired=None, model=None, replica=None):
+                         retired=None, model=None, replica=None,
+                         resident=None, suspended=None):
         """One continuous-batching decode dispatch
         (paddle_tpu.serve.scheduler). ``replica`` identifies the fleet
-        member that ran it (serve/fleet.py)."""
+        member that ran it (serve/fleet.py); ``resident``/``suspended``
+        are the session tier's in-slot vs paged-out session counts at
+        dispatch time (docs/serving.md "Session tier & paging")."""
         rec = {"type": "serve_decode", "iteration": int(iteration),
                "active": int(active), "window": int(window),
                "infer_ms": round(float(infer_ms), 4),
@@ -449,6 +461,36 @@ class StepLog:
             rec["admitted"] = int(admitted)
         if retired is not None:
             rec["retired"] = int(retired)
+        if model is not None:
+            rec["model"] = str(model)
+        if replica is not None:
+            rec["replica"] = str(replica)
+        if resident is not None:
+            rec["resident"] = int(resident)
+        if suspended is not None:
+            rec["suspended"] = int(suspended)
+        self.write(rec)
+
+    def log_serve_swap(self, op, session, nbytes=None, overlap_ms=None,
+                       reason=None, pos=None, model=None, replica=None):
+        """One session-tier paging event (paddle_tpu.serve.scheduler /
+        serve/sessions.py): ``op`` is ``spill`` (carry paged out to the
+        host store; ``overlap_ms`` is the device->host copy time the
+        next window dispatch absorbed), ``restore`` (carry paged back
+        into a slot), ``evict`` (pushed out of the store —
+        ``reason`` in capacity/ttl/error), or ``export``/``import``
+        (cross-replica carry migration, serve/fleet.py)."""
+        rec = {"type": "serve_swap", "op": str(op),
+               "session": str(session),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if nbytes is not None:
+            rec["bytes"] = int(nbytes)
+        if overlap_ms is not None:
+            rec["overlap_ms"] = round(float(overlap_ms), 4)
+        if reason is not None:
+            rec["reason"] = str(reason)
+        if pos is not None:
+            rec["pos"] = int(pos)
         if model is not None:
             rec["model"] = str(model)
         if replica is not None:
@@ -576,18 +618,32 @@ def _serve_replica_summary(records):
     per = {}
     for rec in records:
         rtype = rec.get("type")
-        if rtype not in ("serve_batch", "serve_decode"):
+        if rtype not in ("serve_batch", "serve_decode", "serve_swap"):
             continue
         d = per.setdefault(str(rec.get("replica", "-")),
                            {"dispatches": 0, "completed": 0, "occ": [],
+                            "swaps": collections.Counter(),
+                            "resident": None, "suspended": None,
                             "t0": None, "t1": None})
+        if rtype == "serve_swap":
+            # session-tier paging activity: spill/restore/evict counts
+            # feed the swap rate `cli observe` prints. Swap records do
+            # NOT extend t0/t1 — an idle-threshold spill minutes after
+            # the last dispatch (or an export at shutdown) would
+            # stretch the active span and deflate the reported qps
+            d["swaps"][rec.get("op", "?")] += 1
+            continue
         d["dispatches"] += 1
         if rtype == "serve_batch":
             d["completed"] += rec.get("requests", 0)
-        else:
+        elif rtype == "serve_decode":
             d["completed"] += rec.get("retired", 0)
             if rec.get("slots"):
                 d["occ"].append(rec["active"] / rec["slots"])
+            if "resident" in rec:
+                d["resident"] = rec["resident"]
+            if "suspended" in rec:
+                d["suspended"] = rec["suspended"]
         t = rec.get("t")
         if t is not None:
             d["t0"] = t if d["t0"] is None else min(d["t0"], t)
@@ -603,6 +659,18 @@ def _serve_replica_summary(records):
         if d["occ"]:
             entry["occupancy_mean"] = round(sum(d["occ"]) / len(d["occ"]),
                                             3)
+        if d["swaps"]:
+            entry["spills"] = d["swaps"].get("spill", 0)
+            entry["restores"] = d["swaps"].get("restore", 0)
+            entry["evictions"] = d["swaps"].get("evict", 0)
+            swaps = entry["spills"] + entry["restores"]
+            if span > 0 and swaps:
+                entry["swap_per_s"] = round(swaps / span, 2)
+        # resident-vs-suspended session counts (last dispatch's view)
+        if d["resident"] is not None:
+            entry["resident_sessions"] = d["resident"]
+        if d["suspended"] is not None:
+            entry["suspended_sessions"] = d["suspended"]
         out[key] = entry
     return out
 

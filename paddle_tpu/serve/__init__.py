@@ -14,7 +14,13 @@ Three pillars (docs/serving.md):
 * :class:`ContinuousScheduler` (serve/scheduler.py) — iteration-level
   ("continuous") batching for recurrent bundles exported with
   ``decode_slots=``: admit/retire sequences between window dispatches
-  over a fixed slot matrix with reset-zeroed carry reuse.
+  over a fixed slot matrix with reset-zeroed carry reuse — plus the
+  host-side **session tier** (serve/sessions.py
+  :class:`SessionStore`): quiescent sessions page their recurrent
+  carry out to a bounded host store (async device_get overlapped with
+  the next dispatch) and restore on their next request, so live
+  sessions scale past ``decode_slots`` instead of 429ing
+  (:class:`SessionGone` is the evicted-session 410 path).
 * :class:`Router` (serve/router.py) — multi-model hosting with
   priority classes, bounded queues and :class:`Overloaded` load
   shedding (the HTTP 429 path).
@@ -43,6 +49,8 @@ from paddle_tpu.serve.fleet import ReplicaSet
 from paddle_tpu.serve.generate import generate
 from paddle_tpu.serve.router import Router
 from paddle_tpu.serve.scheduler import ContinuousScheduler
+from paddle_tpu.serve.sessions import (ConsistentHashRing, SessionGone,
+                                       SessionStore)
 
 
 def __getattr__(name):
@@ -54,7 +62,8 @@ def __getattr__(name):
                          % name)
 
 
-__all__ = ["Bundle", "BundleReplica", "ContinuousScheduler",
-           "InferenceEngine", "Overloaded", "ReplicaSet", "Router",
+__all__ = ["Bundle", "BundleReplica", "ConsistentHashRing",
+           "ContinuousScheduler", "InferenceEngine", "Overloaded",
+           "ReplicaSet", "Router", "SessionGone", "SessionStore",
            "export_bundle", "generate", "is_bundle", "load_bundle",
            "verify_bundle"]

@@ -332,6 +332,56 @@ class Bundle:
         jit-wrapper boundary, :meth:`_decode_fn`)."""
         return self._decode_fn(slots)(self.params(device), carry, flat)
 
+    def _carry_ops(self):
+        """Cached jit helpers of the session tier (serve/sessions.py):
+        ``slice(carry, idx)`` extracts one slot's carry rows as FRESH
+        device buffers (safe to device_get after the matrix itself is
+        donated into the next decode dispatch) and ``insert(carry,
+        rows, idx)`` writes host rows back into a slot (carry donated —
+        the restore path next to the exported step's reset zeroing).
+        The slot index is a TRACED scalar on purpose: a Python-int
+        index would bake into the jaxpr and mint one program per slot,
+        where these two programs cover every slot at every capacity."""
+        key = "carry_ops"
+        fns = self._executables.get(key)  # paddle-lint: disable=PTA005
+        if fns is None:
+            with self._exe_lock:
+                fns = self._executables.get(key)
+                if fns is None:
+                    import jax
+                    from jax import lax
+
+                    def _slice(carry, idx):
+                        return jax.tree_util.tree_map(
+                            lambda leaf: lax.dynamic_index_in_dim(
+                                leaf, idx, 0, keepdims=False), carry)
+
+                    def _insert(carry, rows, idx):
+                        return jax.tree_util.tree_map(
+                            lambda leaf, row: lax.dynamic_update_index_in_dim(
+                                leaf, row.astype(leaf.dtype), idx, 0),
+                            carry, rows)
+
+                    fns = (jax.jit(_slice),
+                           jax.jit(_insert, donate_argnums=(0,)))
+                    self._executables[key] = fns
+        return fns
+
+    def carry_slice(self, carry, index):
+        """One slot's carry rows as fresh device arrays:
+        ``{layer: [row, ...]}`` with the slot dimension sliced off —
+        the spill extraction of the session tier. Async like any jit
+        dispatch: the device→host read happens wherever the caller
+        materializes the rows (the scheduler's spill-writer thread)."""
+        return self._carry_ops()[0](carry, np.int32(index))
+
+    def carry_insert(self, carry, rows, index):
+        """Write one session's (host) carry rows into slot ``index`` of
+        the carry matrix — the reset=0 restore path. ``carry`` is
+        DONATED: callers rebind (``carry = bundle.carry_insert(carry,
+        ...)``), exactly like the decode step itself."""
+        return self._carry_ops()[1](carry, rows, np.int32(index))
+
     def dummy_decode_flat(self, slots=None, window=None):
         """Zero-valued decode-step inputs (warmup/selfcheck)."""
         slots = int(self._decode_bucket(slots)["slots"])
@@ -359,8 +409,18 @@ class Bundle:
         carry, _ = self.decode_step(carry,
                                     self.dummy_decode_flat(slot_count),
                                     slot_count, device=device)
-        self.decode_step(carry, self.dummy_decode_flat(slot_count),
-                         slot_count, device=device)
+        carry, _ = self.decode_step(carry,
+                                    self.dummy_decode_flat(slot_count),
+                                    slot_count, device=device)
+        # warm the session tier's spill/restore programs too: slice one
+        # slot out (device buffers -> host rows, the spill shape) and
+        # insert the host rows back (the restore shape) — after this,
+        # session paging mints zero compiles, same contract as the
+        # decode step itself (tests/test_sessions.py pins it)
+        rows = self.carry_slice(carry, 0)
+        host_rows = {layer: [np.asarray(leaf) for leaf in leaves]
+                     for layer, leaves in rows.items()}
+        self.carry_insert(carry, host_rows, 0)
         return slot_count
 
     def run(self, flat_inputs, batch, device=None):

@@ -44,12 +44,19 @@ fit N copies fails loudly at build time, not at the k-th replica's
 first dispatch.
 """
 
+import collections
 import threading
 
 from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.observe import steplog as observe_steplog
 from paddle_tpu.serve.engine import InferenceEngine, Overloaded
 from paddle_tpu.serve.scheduler import ContinuousScheduler
+from paddle_tpu.serve.sessions import ConsistentHashRing, SessionGone
+
+# the fleet's session->replica assignment memory is a ROUTING HINT, not
+# session state (the carries live in each replica's scheduler/store):
+# bound it so a million one-shot sessions cannot grow the front door
+_SESSION_HOME_CAP = 1 << 20
 
 
 class Replica:
@@ -213,6 +220,21 @@ class ReplicaSet:
         self._members = tuple(members)
         self._lock = threading.Lock()
         self._rr = 0
+        # fleet-wide session affinity (docs/serving.md "Session tier &
+        # paging"): sessions consistent-hash onto the replica ring so a
+        # resumed session lands on the replica whose store holds its
+        # carry; ``_session_home`` remembers where each session's carry
+        # actually sits, so when the ring's preference diverges from
+        # reality (home replica died or came back) the carry MIGRATES
+        # instead of silently restarting from zero
+        self._ring = (ConsistentHashRing([m.index for m in members])
+                      if continuous else None)
+        self._session_home = collections.OrderedDict()
+        # migrations serialize on this lock (they are rare — a home
+        # replica died or came back): without it, two concurrent
+        # requests for one session could race the export→import window
+        # and the loser would silently start a fresh zero carry
+        self._migrate_lock = threading.Lock()
 
     def replicas(self):
         """The fleet members, in index order (immutable tuple)."""
@@ -226,7 +248,14 @@ class ReplicaSet:
         return [m for m in self._members
                 if m.engine.ready() and m.engine.live()]
 
-    def submit(self, inputs):
+    @property
+    def supports_sessions(self):
+        """Session requests route here only when the member engines can
+        hold a session carry (continuous schedulers)."""
+        return self.continuous
+
+    def submit(self, inputs, session_id=None, priority=None,
+               end_session=False):
         """Dispatch one request to the least-queued eligible replica
         (round-robin among ties); returns that engine's Future. The
         depth reads are a point-in-time heuristic — two concurrent
@@ -234,7 +263,15 @@ class ReplicaSet:
         of imbalance, not correctness. Raises
         :class:`~paddle_tpu.serve.engine.Overloaded` when no replica is
         eligible (still warming, or every worker dead) or when the
-        chosen replica's own queue bound sheds."""
+        chosen replica's own queue bound sheds.
+
+        With ``session_id`` the request routes by **session affinity**
+        instead: the consistent-hash ring names the session's home
+        replica, so every request of one conversation lands where its
+        carry lives; when the home is dead/cold the ring's next
+        eligible replica takes over and the carry **migrates**
+        (export_session -> import_session) before the request lands —
+        never a silent zero-carry restart."""
         eligible = self._eligible()
         if not eligible:
             self._m_shed.inc()
@@ -243,6 +280,20 @@ class ReplicaSet:
                 "failed) — retry after /readyz goes green"
                 % len(self._members),
                 model=self.model, reason="no_replica")
+        if session_id is not None:
+            if self._ring is None:
+                # refuse loudly: silently running the request
+                # sessionless would discard the carry the caller asked
+                # to keep (mirrors the router's supports_sessions check)
+                raise ValueError(
+                    "this fleet does not hold sessions (whole-request "
+                    "engines); construct with continuous=True over a "
+                    "decode-capable bundle")
+            member = self._route_session(str(session_id), eligible)
+            return member.engine.submit(inputs,
+                                        session_id=str(session_id),
+                                        priority=priority,
+                                        end_session=end_session)
         n = len(eligible)
         with self._lock:
             offset = self._rr
@@ -255,8 +306,95 @@ class ReplicaSet:
         best = min(range(n), key=lambda j: (depths[j], j))
         return order[best].engine.submit(inputs)
 
-    def infer(self, inputs, timeout=60.0):
-        return self.submit(inputs).result(timeout=timeout)
+    def _route_session(self, sid, eligible):
+        """The session's target replica: first eligible member in ring
+        order. When the carry sits elsewhere (``_session_home``), pull
+        it over before the request lands — the fallback that makes a
+        dead replica's sessions survive it (its store and parked
+        carries are host/process memory, readable after the worker
+        died)."""
+        eligible_idx = {m.index for m in eligible}
+        target = None
+        for idx in self._ring.order(sid):
+            if idx in eligible_idx:
+                target = self._members[idx]
+                break
+        if target is None:  # unreachable: eligible is non-empty
+            target = eligible[0]
+        with self._lock:
+            home = self._session_home.get(sid)
+        if home is None:
+            # the bounded hint table forgot this session (cap eviction,
+            # or the ring home recovered after a failover moved the
+            # carry elsewhere): probe the members before treating it as
+            # new — restoring from the wrong replica's empty store
+            # would silently zero-carry restart the conversation
+            for member in self._members:
+                if (member.index != target.index
+                        and member.engine.has_session(sid)):
+                    home = member.index
+                    break
+        if home is not None and home != target.index:
+            # serialize the export→import window: a concurrent request
+            # for the SAME session must see either the pre-migration
+            # home (and migrate itself) or the post-migration home —
+            # never the half-moved state, which would zero-carry
+            # restart the loser and later resurrect a stale store copy
+            with self._migrate_lock:
+                probed = home
+                with self._lock:
+                    # re-read: a concurrent migration winner updated the
+                    # hint; fall back to the probe's answer when the
+                    # bounded table still has no entry
+                    home = self._session_home.get(sid)
+                if home is None:
+                    home = probed
+                if home is not None and home != target.index:
+                    old = self._members[home]
+                    try:
+                        state = old.engine.export_session(sid)
+                    except SessionGone:
+                        # evicted at its home is gone FLEET-wide: keep
+                        # the home hint so retries keep answering 410
+                        # off the tombstone instead of silently
+                        # starting fresh on the new target
+                        raise
+                    except KeyError:
+                        state = None  # the home never held this id
+                    if state is not None:
+                        target.engine.import_session(sid, state)
+                    self._set_home(sid, target.index)
+            return target
+        self._set_home(sid, target.index)
+        return target
+
+    def _set_home(self, sid, index):
+        with self._lock:
+            self._session_home[sid] = index
+            self._session_home.move_to_end(sid)
+            while len(self._session_home) > _SESSION_HOME_CAP:
+                self._session_home.popitem(last=False)
+
+    def close_session(self, session_id):
+        """Abort a session fleet-wide: drop the routing hint and close
+        it on the replica that holds its carry (every member when the
+        bounded hint table no longer remembers — close is idempotent
+        and a miss is a no-op, so the sweep cannot hurt)."""
+        if self._ring is None:
+            return  # whole-request engines hold no sessions
+        sid = str(session_id)
+        with self._lock:
+            home = self._session_home.pop(sid, None)
+        members = ([self._members[home]] if home is not None
+                   else self._members)
+        for member in members:
+            member.engine.close_session(sid)
+
+    def infer(self, inputs, timeout=60.0, session_id=None, priority=None,
+              end_session=False):
+        return self.submit(inputs, session_id=session_id,
+                           priority=priority,
+                           end_session=end_session).result(timeout=timeout)
 
     def queue_depth(self):
         """Total queued rows across every replica (the router's
@@ -293,8 +431,13 @@ class ReplicaSet:
             "per_replica": per,
         }
         for key in ("requests", "rows", "batches", "shed",
-                    "queue_depth", "in_flight"):
+                    "queue_depth", "in_flight", "spills", "restores",
+                    "evictions", "resident_sessions",
+                    "suspended_sessions"):
             out[key] = sum(s.get(key, 0) for s in per.values())
+        if self._ring is not None:
+            with self._lock:
+                out["session_routes"] = len(self._session_home)
         if self.model:
             out["model"] = self.model
         if self.hbm_estimate_bytes is not None:

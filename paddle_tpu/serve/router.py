@@ -137,10 +137,14 @@ class Router:
                                       priority=hosted.priority,
                                       queued=queued)
 
-    def submit(self, name, inputs):
+    def submit(self, name, inputs, session_id=None, end_session=False):
         """Route one request to model ``name``; returns the engine's
         Future. Raises :class:`Overloaded` (fast, before any queue) when
-        admission control sheds it."""
+        admission control sheds it. ``session_id`` threads through to
+        session-capable engines (the continuous scheduler / fleet) with
+        the hosted model's PRIORITY CLASS attached — the session store's
+        eviction order is the router's shed order (low pages out
+        first, docs/serving.md "Session tier & paging")."""
         hosted = self.model(name)
         ceiling = self.shed_capacity.get(hosted.priority)
         if ceiling is not None:
@@ -153,14 +157,26 @@ class Router:
                     model=hosted.name, priority=hosted.priority,
                     reason="pressure", queued=queued)
         try:
+            if session_id is not None:
+                if not getattr(hosted.engine, "supports_sessions", False):
+                    raise ValueError(
+                        "model %r does not hold sessions (re-export "
+                        "with decode_slots= and serve --continuous)"
+                        % hosted.name)
+                return hosted.engine.submit(inputs,
+                                            session_id=session_id,
+                                            priority=hosted.priority,
+                                            end_session=end_session)
             return hosted.engine.submit(inputs)
         except Overloaded as exc:
             exc.priority = hosted.priority
             self._shed(hosted, exc.reason, exc.queued, count=False)
             raise
 
-    def infer(self, name, inputs, timeout=60.0):
-        return self.submit(name, inputs).result(timeout=timeout)
+    def infer(self, name, inputs, timeout=60.0, session_id=None,
+              end_session=False):
+        return self.submit(name, inputs, session_id=session_id,
+                           end_session=end_session).result(timeout=timeout)
 
     # -- health -------------------------------------------------------------
     def ready(self):

@@ -1,6 +1,7 @@
 """Continuous-batching decode scheduler: iteration-level scheduling
 over a fixed-capacity slot matrix (docs/serving.md "Continuous
-batching").
+batching"), with a host-side **session tier** above it (docs/serving.md
+"Session tier & paging").
 
 The whole-request engine (serve/engine.py) pads every sequence to the
 bundle's exported ``seq_len`` and a long decode holds its co-batched
@@ -24,11 +25,34 @@ retires sequences between dispatches**:
   single jit entry no matter how slots churn (``jit_entries`` pinned
   via ``observe.steplog.watch_compiles`` in tier-1).
 
+**Sessions** (``submit(..., session_id=...)``) break the concurrency
+ceiling the slot matrix would otherwise impose: a session's recurrent
+carry survives between requests, so a conversation decodes
+incrementally across many requests. Slots hold *active* sequences
+only — when a session's request retires, the session **parks** in its
+slot (carry stays device-resident) until the slot is needed or the
+idle-spill threshold passes, at which point the scheduler **spills**
+the carry to the host-side :class:`~paddle_tpu.serve.sessions
+.SessionStore` with an async device→host copy overlapped with the next
+window dispatch (the named ``serve-session-spill`` writer thread owns
+the blocking read). The session's next request **restores** the carry
+into whatever slot is free (``Bundle.carry_insert`` — the ``reset=0``
+restore path next to the exported step's ``reset=1`` zeroing) —
+spill→restore is bitwise-equivalent to a pinned slot, pinned by
+tests/test_sessions.py, so paging is invisible to the model. Store
+eviction is priority-ordered LRU with SLO grace
+(serve/sessions.py); an evicted session answers 410 Gone
+(:class:`~paddle_tpu.serve.sessions.SessionGone`). This converts the
+admission cap from "reject above decode_slots" into "gracefully page
+above decode_slots" — thousands of sessions per host become millions.
+
 Observability mirrors the engine: per-iteration ``serve_decode`` and
-per-request ``serve_request`` steplog records (schema v1), the
-``paddle_tpu_serve_*`` metric families labeled ``{model=...}`` plus
-decode-specific series (iterations, slot-steps, occupancy), and the
-k8s-style ready/live split with failed-warmup-stays-not-ready.
+per-request ``serve_request`` steplog records plus per-swap
+``serve_swap`` records (schema v1), the ``paddle_tpu_serve_*`` metric
+families labeled ``{model=...}`` plus decode- and session-specific
+series (iterations, slot-steps, occupancy, spills/restores/evictions,
+resident/suspended gauges, swap-latency histogram), and the k8s-style
+ready/live split with failed-warmup-stays-not-ready.
 """
 
 import collections
@@ -43,13 +67,16 @@ from paddle_tpu.observe import spans as observe_spans
 from paddle_tpu.observe import steplog as observe_steplog
 from paddle_tpu.serve.bundle import SEQ_KINDS
 from paddle_tpu.serve.engine import Overloaded
+from paddle_tpu.serve.sessions import SessionGone, SessionState, SessionStore
 
 
 class _DecodeRequest:
     __slots__ = ("data", "length", "future", "t_enqueue", "t_admit",
-                 "req_id", "collected")
+                 "req_id", "collected", "session", "priority",
+                 "end_session")
 
-    def __init__(self, data, length, req_id):
+    def __init__(self, data, length, req_id, session=None,
+                 priority=None, end_session=False):
         self.data = data          # {input_name: [T, ...] array}
         self.length = length
         self.future = Future()
@@ -57,33 +84,82 @@ class _DecodeRequest:
         self.t_admit = None
         self.req_id = req_id
         self.collected = []       # [{out_name: [k, ...]}] per window
+        self.session = None if session is None else str(session)
+        self.priority = priority
+        self.end_session = bool(end_session)
+
+
+class _ResidentSession:
+    """A session whose carry lives in the slot matrix (active while its
+    request decodes, *parked* between requests)."""
+
+    __slots__ = ("sid", "pos", "priority", "last_active")
+
+    def __init__(self, sid, priority=None, pos=0):
+        self.sid = sid
+        self.pos = int(pos)
+        self.priority = priority or "normal"
+        self.last_active = time.monotonic()
 
 
 class _Slot:
-    __slots__ = ("req", "pos")
+    __slots__ = ("req", "pos", "session")
 
     def __init__(self):
         self.req = None
         self.pos = 0
+        self.session = None  # _ResidentSession while resident
+
+
+class _Plan:
+    """One iteration's admission/paging decisions, taken under the
+    scheduler lock; the device work (slice/insert/decode) runs after
+    release so submitters never block on a dispatch."""
+
+    __slots__ = ("admitted", "restores", "spills", "failures")
+
+    def __init__(self):
+        self.admitted = []   # fresh slot indices (reset=1)
+        self.restores = []   # (slot index, SessionState)
+        self.spills = []     # (slot index, _ResidentSession)
+        self.failures = []   # (request, exception) — resolved outside cv
 
 
 class ContinuousScheduler:
     """Iteration-level ("continuous") batching front end of a decode-
-    capable :class:`Bundle`.
+    capable :class:`Bundle`, with host-side session paging.
 
     ``submit(inputs)`` takes ONE sequence per request — the same flat
     wire format as the engine with a single row (``{name: [1, T] ids,
     name+":lens": [1]}``; the lens key may be omitted when the data
     array is exactly the sequence) — and returns a Future resolving to
     ``{output_name: np.ndarray[T, ...]}`` with one output row per
-    timestep. Duck-type compatible with :class:`InferenceEngine`
-    (submit/infer/stats/ready/live/queue_depth/stop), so the router and
-    the HTTP front end host either interchangeably.
+    timestep. ``submit(inputs, session_id="u123")`` continues that
+    session's carry instead of starting from zero (restoring it from
+    the host store when it was paged out); ``end_session=True`` closes
+    the session with the request. Duck-type compatible with
+    :class:`InferenceEngine` (submit/infer/stats/ready/live/
+    queue_depth/stop), so the router and the HTTP front end host either
+    interchangeably.
+
+    Session knobs: ``session_capacity`` bounds the host store,
+    ``idle_spill_ms`` spills a parked session after that much idle time
+    (None = spill only under slot pressure), ``session_slo_grace_ms``
+    and ``session_ttl_ms`` shape eviction (serve/sessions.py), and
+    ``paging=False`` reproduces the pre-session behavior where a live
+    session pins its slot for life — the hard-cap baseline the
+    ``--mode sessions`` bench A/Bs against.
     """
+
+    # sessions are first-class here (serve/server.py routes session
+    # requests only to engines that advertise it)
+    supports_sessions = True
 
     def __init__(self, bundle, slots=None, steplog=None, warmup=True,
                  run_name="serve", metrics_registry=None, model=None,
-                 max_queue=256, replica=None):
+                 max_queue=256, replica=None, session_capacity=4096,
+                 idle_spill_ms=None, session_slo_grace_ms=None,
+                 session_ttl_ms=None, paging=True, session_store=None):
         if not bundle.has_decoder():
             raise ValueError(
                 "bundle %r has no decode artifacts; re-export with "
@@ -97,6 +173,9 @@ class ContinuousScheduler:
         # plus an additive ``replica`` field on serve_decode records
         self.replica = None if replica is None else str(replica)
         self.max_queue = None if max_queue is None else int(max_queue)
+        self.paging = bool(paging)
+        self.idle_spill_ms = (None if idle_spill_ms is None
+                              else float(idle_spill_ms))
         self._labels = {"model": str(model)} if model else {}
         if self.replica is not None:
             self._labels["replica"] = self.replica
@@ -112,6 +191,19 @@ class ContinuousScheduler:
         self._stats = collections.Counter()
         self._slots = [_Slot() for _ in range(self.slots)]
         self._carry = None  # device-resident between iterations
+        # -- session tier state (all guarded by self._cv) ------------------
+        self._session_slots = {}    # sid -> slot index (resident)
+        self._pending_spills = {}   # sid -> True while the writer commits
+        self._spill_asap = set()    # sids with a forced spill requested
+        self._closing = set()       # closed while their spill is in flight
+        # the host-side page file: suspended carries + tombstones
+        self._store = session_store or SessionStore(
+            capacity=session_capacity, slo_grace_ms=session_slo_grace_ms,
+            ttl_ms=session_ttl_ms)
+        # -- spill writer (guarded by self._swap_cv) -----------------------
+        self._swap_cv = threading.Condition()
+        self._swap_q = collections.deque()
+        self._swap_stop = False
         self._owns_slog = steplog is None
         # serving records arrive at request rate: batch the flush
         # (crash loses <32 records, not the throughput — steplog.py)
@@ -137,6 +229,14 @@ class ContinuousScheduler:
         else:
             self._ready.set()
             self._m_ready.set(1)
+        # the spill writer owns the BLOCKING device->host reads so the
+        # decode worker never waits on a transfer: a spilled slot's
+        # device_get overlaps the next window dispatch (named thread,
+        # joined in stop() — the analyze thread-leak gate covers it)
+        self._swap_writer = threading.Thread(
+            target=self._swap_writer_loop,
+            name=self._thread_name("serve-session-spill"), daemon=True)
+        self._swap_writer.start()
         self._worker = threading.Thread(
             target=self._loop,
             name=self._thread_name("serve-decode-worker"), daemon=True)
@@ -150,6 +250,8 @@ class ContinuousScheduler:
 
     # the decode step is ONE exported program per (slots, window) pair:
     # after warmup, slot admission/retirement can never mint a shape
+    # (the session tier's slice/insert helpers are warmed alongside it,
+    # so paging churn cannot either)
     jit_entries = 1
 
     def _warmup(self):
@@ -225,12 +327,50 @@ class ContinuousScheduler:
         self._m_iter_ms = m.histogram(
             "paddle_tpu_serve_decode_iter_ms",
             help="device time per decode window dispatch", labels=lab)
+        # -- session tier families (docs/observability.md) -----------------
+        self._m_spills = m.counter(
+            "paddle_tpu_serve_session_spills_total",
+            help="session carries paged out to the host store",
+            labels=lab)
+        self._m_restores = m.counter(
+            "paddle_tpu_serve_session_restores_total",
+            help="session carries paged back into a decode slot",
+            labels=lab)
+        self._m_evicted = {}
+        for reason in ("capacity", "ttl", "error"):
+            self._m_evicted[reason] = m.counter(
+                "paddle_tpu_serve_session_evictions_total",
+                help="sessions evicted from the host store",
+                labels=dict(lab, reason=reason))
+        self._m_resident = m.gauge(
+            "paddle_tpu_serve_session_resident",
+            help="sessions whose carry is in a decode slot", labels=lab)
+        self._m_suspended = m.gauge(
+            "paddle_tpu_serve_session_suspended",
+            help="sessions paged out to the host store", labels=lab)
+        self._m_swap_ms = m.histogram(
+            "paddle_tpu_serve_session_swap_ms",
+            help="device<->host carry copy latency per swap", labels=lab)
 
     # -- client surface -----------------------------------------------------
-    def submit(self, inputs):
+    def submit(self, inputs, session_id=None, priority=None,
+               end_session=False):
         """Enqueue ONE sequence; returns a Future of
-        {output_name: array[T, ...]} (one output row per timestep)."""
+        {output_name: array[T, ...]} (one output row per timestep).
+        With ``session_id`` the decode continues that session's carry
+        (a new id starts fresh; an EVICTED id raises
+        :class:`SessionGone` — the 410 path)."""
         data, length = self._normalize(inputs)
+        sid = None if session_id is None else str(session_id)
+        if sid is not None:
+            # gone check BEFORE the queue: an evicted session fails
+            # fast instead of camping in the queue to fail at admission
+            reason = self._store.gone_reason(sid)
+            if reason is not None:
+                raise SessionGone(
+                    "session %r was evicted (reason=%s); start a new "
+                    "session" % (sid, reason), session_id=sid,
+                    reason=reason)
         with self._cv:
             if self._stopped:
                 raise RuntimeError("scheduler is stopped")
@@ -244,7 +384,9 @@ class ContinuousScheduler:
                     model=self.model, reason="queue_full",
                     queued=len(self._queue))
             self._req_counter += 1
-            req = _DecodeRequest(data, length, self._req_counter)
+            req = _DecodeRequest(data, length, self._req_counter,
+                                 session=sid, priority=priority,
+                                 end_session=end_session)
             self._queue.append(req)
             self._in_flight += 1
             self._m_queue_depth.set(len(self._queue))
@@ -252,8 +394,11 @@ class ContinuousScheduler:
             self._cv.notify_all()
         return req.future
 
-    def infer(self, inputs, timeout=60.0):
-        return self.submit(inputs).result(timeout=timeout)
+    def infer(self, inputs, timeout=60.0, session_id=None, priority=None,
+              end_session=False):
+        return self.submit(inputs, session_id=session_id,
+                           priority=priority,
+                           end_session=end_session).result(timeout=timeout)
 
     def queue_depth(self):
         with self._cv:
@@ -316,15 +461,21 @@ class ContinuousScheduler:
         return data, length
 
     def stats(self):
+        store_stats = self._store.stats()
         with self._cv:
             out = dict(self._stats)
             for key in ("requests", "rows", "iterations", "slot_steps",
-                        "admitted", "retired", "shed"):
+                        "admitted", "retired", "shed", "spills",
+                        "restores", "evictions", "sessions_closed"):
                 out.setdefault(key, 0)
             out["queue_depth"] = len(self._queue)
             out["in_flight"] = self._in_flight
             out["slots"] = self.slots
             out["window"] = self.window
+            out["resident_sessions"] = len(self._session_slots)
+        out["suspended_sessions"] = store_stats["suspended"]
+        out["session_capacity"] = store_stats["capacity"]
+        out["session_bytes"] = store_stats["bytes"]
         if self.model:
             out["model"] = self.model
         if self.replica is not None:
@@ -334,12 +485,21 @@ class ContinuousScheduler:
         return out
 
     def stop(self, timeout=30.0):
-        """Drain queued and in-slot sequences, stop the worker, close an
-        owned steplog. Idempotent."""
+        """Drain queued and in-slot sequences, stop the worker and the
+        spill writer, close an owned steplog. Idempotent. Parked and
+        suspended session carries survive in host/process memory for
+        :meth:`export_session` (the fleet's migration path reads a
+        stopped replica's sessions out)."""
         with self._cv:
             self._stopped = True
             self._cv.notify_all()
         self._worker.join(timeout=timeout)
+        # the writer drains its queue before exiting, so every spill
+        # the worker enqueued while draining still commits
+        with self._swap_cv:
+            self._swap_stop = True
+            self._swap_cv.notify_all()
+        self._swap_writer.join(timeout=timeout)
         if self._owns_slog and self._slog is not None:
             self._slog.close()
             self._slog = None
@@ -350,36 +510,417 @@ class ContinuousScheduler:
     def __exit__(self, *exc):
         self.stop()
 
+    # -- session control surface --------------------------------------------
+    def spill_session(self, session_id, timeout=30.0):
+        """Force one parked session's carry out to the host store and
+        return once it committed — the ops drain hook, and what the
+        bitwise spill→restore tests use to make paging deterministic.
+        No-op when the session is already suspended; raises KeyError
+        for an unknown session and :class:`SessionGone` for an evicted
+        one."""
+        sid = str(session_id)
+        self._suspend(sid, timeout)
+        if sid not in self._store:
+            reason = self._store.gone_reason(sid)
+            if reason is not None:
+                raise SessionGone(
+                    "session %r was evicted (reason=%s)" % (sid, reason),
+                    session_id=sid, reason=reason)
+            raise KeyError(sid)
+
+    def has_session(self, session_id):
+        """True when this scheduler holds state for the session —
+        resident in a slot, mid-spill, or suspended in the store. The
+        fleet's migration fallback probes this when its bounded
+        routing-hint table no longer remembers where a session's carry
+        sits (serve/fleet.py)."""
+        sid = str(session_id)
+        with self._cv:
+            if sid in self._session_slots or sid in self._pending_spills:
+                return True
+        return sid in self._store
+
+    def close_session(self, session_id):
+        """Abort a session wherever it sits: frees its slot when
+        parked, closes at retire when a request is in flight, drops it
+        from the store when suspended (closed, not evicted — no
+        tombstone, the id may start fresh). Idempotent; unknown ids
+        are a no-op. The front door calls this when a client abandons
+        a conversation — without it, an abandoned session pins its
+        slot (hard-cap mode) or ages in the store until TTL/capacity
+        eviction."""
+        sid = str(session_id)
+        with self._cv:
+            idx = self._session_slots.get(sid)
+            if idx is not None:
+                slot = self._slots[idx]
+                if slot.req is not None:
+                    slot.req.end_session = True  # closes at retire
+                else:
+                    self._detach_locked(idx)
+                    self._stats["sessions_closed"] += 1
+                    self._cv.notify_all()
+            elif sid in self._pending_spills:
+                # mid-spill: the writer must DISCARD the carry instead
+                # of committing it — otherwise a new conversation
+                # reusing the id would silently resume the dead one's
+                # state from the store
+                self._closing.add(sid)
+                self._stats["sessions_closed"] += 1
+            self._spill_asap.discard(sid)
+        try:
+            self._store.pop(sid)
+            with self._cv:
+                self._stats["sessions_closed"] += 1
+        except (SessionGone, KeyError):
+            pass
+        self._update_session_gauges()
+
+    def export_session(self, session_id, timeout=30.0):
+        """Remove one session's state from this scheduler (forcing a
+        spill when it is resident) and return the
+        :class:`~paddle_tpu.serve.sessions.SessionState` — the carry
+        migration source (serve/fleet.py). Works on a STOPPED
+        scheduler too: a dead replica's sessions are host/process
+        memory, and reading them out is exactly the fallback the fleet
+        needs when the session's home replica died."""
+        sid = str(session_id)
+        self._suspend(sid, timeout)
+        state = self._store.pop(sid)  # SessionGone / KeyError propagate
+        self._update_session_gauges()
+        self._log_swap("export", sid, state.nbytes, pos=state.pos)
+        return state
+
+    def import_session(self, session_id, state, priority=None):
+        """Adopt a migrated session: its next request restores from
+        this scheduler's store like any suspended session."""
+        sid = str(session_id)
+        adopted = SessionState(sid, state.carry, state.pos,
+                               priority or state.priority)
+        evicted = self._store.put(adopted)
+        self._account_evictions(evicted)
+        with self._cv:
+            self._stats["imports"] += 1
+        self._update_session_gauges()
+        if self._slog is not None:
+            self._slog.log_serve_swap(
+                op="import", session=sid, nbytes=adopted.nbytes,
+                pos=adopted.pos, model=self.model, replica=self.replica)
+
+    def _suspend(self, sid, timeout):
+        """Ensure ``sid`` is not resident: request a forced spill and
+        wait for the writer's commit. On a dead/stopped worker the
+        spill runs synchronously here — no dispatch can race the carry
+        read once the worker exited."""
+        deadline = time.monotonic() + timeout
+        while True:
+            salvage = None
+            with self._cv:
+                if sid in self._pending_spills:
+                    pass  # writer is committing it; wait below
+                elif sid not in self._session_slots:
+                    return  # suspended (or never here): store decides
+                else:
+                    idx = self._session_slots[sid]
+                    slot = self._slots[idx]
+                    if slot.req is None and not self._worker.is_alive():
+                        # dead-worker salvage: synchronous slice + get
+                        # (no dispatch can race the carry read once the
+                        # worker exited — the fleet's dead-replica
+                        # migration source)
+                        ses = slot.session
+                        rows = self.bundle.carry_slice(self._carry, idx)
+                        host = {layer: [np.asarray(leaf)
+                                        for leaf in leaves]
+                                for layer, leaves in rows.items()}
+                        slot.session = None
+                        del self._session_slots[sid]
+                        self._stats["spills"] += 1
+                        salvage = SessionState(sid, host, ses.pos,
+                                               ses.priority)
+                    else:
+                        self._spill_asap.add(sid)
+                        self._cv.notify_all()
+                if salvage is None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            "session %r did not spill within %.1fs "
+                            "(worker alive=%s)"
+                            % (sid, timeout, self._worker.is_alive()))
+                    self._cv.wait(remaining)
+            if salvage is not None:
+                # store commit + accounting OUTSIDE the scheduler lock:
+                # the store has its own lock, and the steplog/metrics
+                # sinks must never run under the admission cv
+                evicted = self._store.put(salvage)
+                self._account_evictions(evicted)
+                self._m_spills.inc()
+                self._log_swap("spill", sid, salvage.nbytes,
+                               pos=salvage.pos)
+                return
+
+    def _log_swap(self, op, sid, nbytes=None, overlap_ms=None,
+                  reason=None, pos=None):
+        if self._slog is not None:
+            self._slog.log_serve_swap(
+                op=op, session=sid, nbytes=nbytes, overlap_ms=overlap_ms,
+                reason=reason, pos=pos, model=self.model,
+                replica=self.replica)
+
+    def _account_evictions(self, evicted, reason="capacity"):
+        for state in evicted:
+            with self._cv:
+                self._stats["evictions"] += 1
+            self._m_evicted.get(reason, self._m_evicted["capacity"]).inc()
+            self._log_swap("evict", state.session_id, state.nbytes,
+                           reason=reason, pos=state.pos)
+
+    def _update_session_gauges(self):
+        with self._cv:
+            resident = len(self._session_slots)
+        self._m_resident.set(resident)
+        self._m_suspended.set(self._store.suspended_count())
+
     # -- worker -------------------------------------------------------------
+    def _spills_due_locked(self, now):
+        """Forced or idle-threshold spills waiting to run (cv held).
+        Forced spills (:meth:`spill_session` / :meth:`export_session`)
+        run even with ``paging=False`` — migration must work off a
+        hard-cap scheduler too; only the idle threshold is a paging
+        feature."""
+        for slot in self._slots:
+            ses = slot.session
+            if ses is None or slot.req is not None:
+                continue
+            if ses.sid in self._spill_asap:
+                return True
+            if (self.paging and self.idle_spill_ms is not None
+                    and (now - ses.last_active) * 1e3
+                    >= self.idle_spill_ms):
+                return True
+        return False
+
+    def _free_slot_possible_locked(self):
+        """A request with no resident slot can be admitted iff some slot
+        is empty or (paging on) parked-and-spillable. cv HELD by every
+        caller (the ``_locked`` convention — reached two helper levels
+        below the cv, past the linter's one-level resolution)."""
+        for slot in self._slots:
+            if slot.req is not None:
+                continue
+            if slot.session is None:
+                return True
+            if (self.paging and slot.session.sid
+                    not in self._spill_asap):  # paddle-lint: disable=PTA005
+                return True
+        return False
+
+    def _admissible_any_locked(self):
+        free = self._free_slot_possible_locked()
+        for req in self._queue:
+            sid = req.session
+            if sid is None:
+                if free:
+                    return True
+                continue
+            if sid in self._pending_spills:
+                continue
+            idx = self._session_slots.get(sid)
+            if idx is not None:
+                if self._slots[idx].req is None:
+                    return True
+                continue
+            if free:
+                return True
+        return False
+
+    def _next_deadline_locked(self, now):
+        """Seconds until the earliest idle-spill deadline, or None."""
+        if not self.paging or self.idle_spill_ms is None:
+            return None
+        soonest = None
+        for slot in self._slots:
+            ses = slot.session
+            if ses is None or slot.req is not None:
+                continue
+            due = ses.last_active + self.idle_spill_ms / 1e3 - now
+            soonest = due if soonest is None else min(soonest, due)
+        return None if soonest is None else max(soonest, 0.0)
+
     def _wait_for_work(self):
-        """Block until a slot is occupied or a request is queued; returns
-        False when stopped AND fully drained."""
+        """Block until there is actionable work; returns False when
+        stopped AND fully drained. Actionable = an occupied slot, an
+        admissible queued request, or a due (forced/idle) spill."""
         with self._cv:
             while True:
-                busy = any(s.req is not None for s in self._slots)
-                if busy or self._queue:
+                now = time.monotonic()
+                if any(s.req is not None for s in self._slots):
+                    return True
+                if self._spills_due_locked(now):
+                    return True
+                if self._queue and self._admissible_any_locked():
                     return True
                 if self._stopped:
-                    return False
-                self._cv.wait()
+                    if not self._queue:
+                        return False
+                    if not self._pending_spills:
+                        # stopping with requests that can never admit
+                        # (e.g. paging off, every slot parked): fail
+                        # them loudly instead of hanging the drain
+                        failed = list(self._queue)
+                        self._queue.clear()
+                        self._m_queue_depth.set(0)
+                        self._in_flight -= len(failed)
+                        self._m_in_flight.set(self._in_flight)
+                        for req in failed:
+                            if not req.future.done():
+                                req.future.set_exception(
+                                    RuntimeError("scheduler stopped "
+                                                 "before admission"))
+                        return False
+                self._cv.wait(self._next_deadline_locked(now))
 
-    def _admit(self):
-        """Fill free slots from the queue; returns the admitted slot
-        indices (their carry must reset this iteration)."""
-        admitted = []
+    def _plan(self):
+        """Admission + paging decisions for one iteration (cv held):
+        fill slots from the queue in arrival order — a session parked
+        in a slot continues there (reset=0, carry untouched), a
+        suspended session claims a free slot and restores (reset=0,
+        carry re-inserted), everything else starts fresh (reset=1) —
+        and pick the spill victims (forced, idle-threshold, and
+        pressure LRU when the queue needs slots that parked sessions
+        hold)."""
+        plan = _Plan()
+        now = time.monotonic()
+        # the store is init-assigned and internally locked — alias it
+        # outside the cv so its own lock never nests inside admission
+        store = self._store
         with self._cv:
+            # 1. forced + idle-threshold spills (forced ones run even
+            # with paging off — the migration path needs them)
             for i, slot in enumerate(self._slots):
-                if slot.req is not None:
+                ses = slot.session
+                if ses is None or slot.req is not None:
                     continue
-                if not self._queue:
-                    break
+                forced = ses.sid in self._spill_asap
+                idle = (self.paging and self.idle_spill_ms is not None
+                        and (now - ses.last_active) * 1e3
+                        >= self.idle_spill_ms)
+                if forced or idle:
+                    plan.spills.append((i, ses))
+                    # pending BEFORE the queue scan below: the spilled
+                    # session's own queued request must wait for the
+                    # writer's commit, not start a fresh zero carry
+                    self._pending_spills[ses.sid] = True
+                    self._detach_locked(i, spilling=True)
+            # 2. queue scan in arrival order
+            leftovers = collections.deque()
+            while self._queue:
                 req = self._queue.popleft()
-                req.t_admit = time.perf_counter()
-                slot.req = req
-                slot.pos = 0
-                admitted.append(i)
+                sid = req.session
+                if sid is None:
+                    idx = self._claim_slot_locked(plan)
+                    if idx is None:
+                        leftovers.append(req)
+                        continue
+                    self._attach_locked(idx, req, now)
+                    plan.admitted.append(idx)
+                    continue
+                if sid in self._pending_spills:
+                    leftovers.append(req)  # writer is mid-commit
+                    continue
+                res_idx = self._session_slots.get(sid)
+                if res_idx is not None:
+                    slot = self._slots[res_idx]
+                    if slot.req is not None:
+                        leftovers.append(req)  # one request at a time
+                        continue
+                    self._attach_locked(res_idx, req, now)
+                    continue  # parked continue: reset=0, no restore
+                # suspended / brand-new / evicted
+                try:
+                    state = store.pop(sid)
+                except SessionGone as exc:
+                    plan.failures.append((req, exc))
+                    continue
+                except KeyError:
+                    state = None  # brand-new session: fresh carry
+                idx = self._claim_slot_locked(plan)
+                if idx is None:
+                    if state is not None:
+                        store.put(state)  # no room yet: back it goes
+                    leftovers.append(req)
+                    continue
+                self._attach_locked(idx, req, now,
+                                    pos=0 if state is None else state.pos)
+                if state is None:
+                    plan.admitted.append(idx)
+                else:
+                    plan.restores.append((idx, state))
+            self._queue = leftovers
             self._m_queue_depth.set(len(self._queue))
-        return admitted
+            self._in_flight -= len(plan.failures)
+            if plan.failures:
+                self._m_in_flight.set(self._in_flight)
+        return plan
+
+    def _claim_slot_locked(self, plan):
+        """An empty slot, else (paging on) the LRU parked slot — whose
+        session is added to the plan's spills and detached so the new
+        occupant can take the slot THIS iteration (the spill's carry
+        slice is enqueued before the insert/decode, so device ordering
+        keeps the read ahead of the overwrite)."""
+        victim_i, victim = None, None
+        for i, slot in enumerate(self._slots):
+            if slot.req is not None:
+                continue
+            if slot.session is None:
+                return i
+            if not self.paging:
+                continue
+            ses = slot.session
+            if victim is None or ses.last_active < victim.last_active:
+                victim_i, victim = i, ses
+        if victim is None:
+            return None
+        plan.spills.append((victim_i, victim))
+        # pending immediately: the victim's own queued request (later
+        # in this same scan) must wait for the spill commit instead of
+        # reading "unknown session" and starting a fresh zero carry
+        self._pending_spills[victim.sid] = True
+        self._detach_locked(victim_i, spilling=True)
+        return victim_i
+
+    def _attach_locked(self, idx, req, now, pos=0):
+        slot = self._slots[idx]
+        slot.req = req
+        slot.pos = 0
+        req.t_admit = time.perf_counter()
+        if req.session is not None:
+            ses = slot.session
+            if ses is None or ses.sid != req.session:
+                ses = _ResidentSession(req.session, req.priority, pos)
+                slot.session = ses
+                self._session_slots[req.session] = idx
+            ses.last_active = now
+            if req.priority:
+                ses.priority = req.priority
+        else:
+            # a sessionless request evicts nothing and parks nothing:
+            # the slot's carry is reset-zeroed and discarded at retire
+            slot.session = None
+
+    def _detach_locked(self, idx, spilling=False):
+        # cv HELD by every caller (the ``_locked`` convention — some
+        # call chains run two helper levels below the cv acquisition,
+        # past the linter's one-level resolution)
+        slot = self._slots[idx]
+        ses = slot.session
+        if ses is not None:
+            self._session_slots.pop(ses.sid, None)  # paddle-lint: disable=PTA005
+            if spilling:
+                self._spill_asap.discard(ses.sid)  # paddle-lint: disable=PTA005
+        slot.session = None
 
     def _loop(self):
         while self._wait_for_work():
@@ -387,33 +928,104 @@ class ContinuousScheduler:
                 self._run_iteration()
             except Exception as exc:  # noqa: BLE001 — fail the occupants, not the engine
                 failed = []
+                lost_sessions = []
                 with self._cv:
-                    for slot in self._slots:
+                    for i, slot in enumerate(self._slots):
                         if slot.req is not None:
                             failed.append(slot.req)
                             slot.req = None
+                        if slot.session is not None:
+                            # the carry matrix is poisoned below: every
+                            # resident session's state is gone with it
+                            lost_sessions.append(slot.session.sid)
+                            self._detach_locked(i)
                     self._in_flight -= len(failed)
                     self._m_in_flight.set(self._in_flight)
                     self._stats["iterations_failed"] += 1
+                    # wake _suspend waiters: their session's fate is
+                    # decided (tombstoned below) — they must see it now,
+                    # not TimeoutError after a full 30s sleep
+                    self._cv.notify_all()
                 self._carry = None  # poisoned by the failed dispatch
+                for sid in lost_sessions:
+                    # tombstone so the next request answers 410 instead
+                    # of silently starting the conversation over
+                    self._store.tombstone(sid, "error")
+                    self._account_evictions(
+                        [SessionState(sid, {}, 0)], reason="error")
+                self._update_session_gauges()
                 for req in failed:
                     if not req.future.done():
                         req.future.set_exception(exc)
 
     def _run_iteration(self):
-        admitted = self._admit()
+        # expire idle suspended sessions BEFORE admission (no-op
+        # without a TTL): a request waking the scheduler after a quiet
+        # period must find its long-expired session tombstoned (410),
+        # not restorable — _plan's store.pop would otherwise resurrect
+        # exactly the sessions the TTL is for
+        expired = self._store.expire()
+        if expired:
+            self._account_evictions(expired, reason="ttl")
+        plan = self._plan()
+        for req, exc in plan.failures:
+            if not req.future.done():
+                req.future.set_exception(exc)
         if self._carry is None:
             self._carry = self.bundle.zero_carry(self.slots)
+        # -- paging: slice spilled carries BEFORE the insert/decode so
+        # the device-ordered reads see the pre-overwrite rows; the
+        # blocking device_get runs on the spill writer, overlapped
+        # with this iteration's dispatch
+        enqueued = 0
+        try:
+            for idx, ses in plan.spills:
+                rows = self.bundle.carry_slice(self._carry, idx)
+                with self._swap_cv:
+                    self._swap_q.append((ses.sid, rows, ses.pos,
+                                         ses.priority,
+                                         time.perf_counter()))
+                    self._swap_cv.notify_all()
+                enqueued += 1
+        except Exception:
+            # a failed slice strands the un-enqueued pending spills:
+            # tombstone them and release their waiters before the
+            # iteration failure propagates (already-enqueued ones
+            # commit normally on the writer)
+            stranded = [ses for _, ses in plan.spills[enqueued:]]
+            with self._cv:
+                for ses in stranded:
+                    self._pending_spills.pop(ses.sid, None)
+                self._cv.notify_all()
+            for ses in stranded:
+                self._store.tombstone(ses.sid, "error")
+                self._account_evictions(
+                    [SessionState(ses.sid, {}, ses.pos)], reason="error")
+            raise
+        for idx, state in plan.restores:
+            t0 = time.perf_counter()
+            self._carry = self.bundle.carry_insert(self._carry,
+                                                   state.carry, idx)
+            restore_ms = (time.perf_counter() - t0) * 1e3
+            with self._cv:
+                self._stats["restores"] += 1
+            self._m_restores.inc()
+            self._m_swap_ms.observe(restore_ms)
+            self._log_swap("restore", state.session_id, state.nbytes,
+                           overlap_ms=restore_ms, pos=state.pos)
+        if plan.spills or plan.restores:
+            self._update_session_gauges()
+        active = sum(1 for s in self._slots if s.req is not None)
+        if active == 0:
+            return  # spill-only service: nothing to decode
         flat = self.bundle.dummy_decode_flat(self.slots, self.window)
         reset = np.zeros((self.slots,), np.float32)
         lens = np.zeros((self.slots,), np.int32)
-        for i in admitted:
+        for i in plan.admitted:
             reset[i] = 1.0
-        active = 0
         for i, slot in enumerate(self._slots):
             if slot.req is None:
                 continue
-            active += 1
             k = min(slot.req.length - slot.pos, self.window)
             lens[i] = k
             for spec in self._seq_specs:
@@ -439,13 +1051,15 @@ class ContinuousScheduler:
         with self._cv:
             self._stats["iterations"] += 1
             self._stats["slot_steps"] += steps
-            self._stats["admitted"] += len(admitted)
+            self._stats["admitted"] += len(plan.admitted)
             self._stats["retired"] += len(retired)
+            self._stats["iter_ms_sum"] += infer_ms
+            resident = len(self._session_slots)
         self._m_iters.inc()
         if steps:
             self._m_slot_steps.inc(steps)
-        if admitted:
-            self._m_admitted.inc(len(admitted))
+        if plan.admitted:
+            self._m_admitted.inc(len(plan.admitted))
         if retired:
             self._m_retired.inc(len(retired))
         self._m_iter_ms.observe(infer_ms)
@@ -454,15 +1068,93 @@ class ContinuousScheduler:
             self._slog.log_serve_decode(
                 iteration=self._iter_counter, active=active,
                 window=self.window, slots=self.slots, steps=steps,
-                admitted=len(admitted), retired=len(retired),
+                admitted=len(plan.admitted), retired=len(retired),
                 infer_ms=infer_ms, model=self.model,
-                replica=self.replica)
+                replica=self.replica, resident=resident,
+                suspended=self._store.suspended_count())
+
+    def _swap_writer_loop(self):
+        """The named spill writer: owns the BLOCKING device->host carry
+        reads so the decode worker's next dispatch overlaps them, then
+        commits to the store and releases the session for restore."""
+        while True:
+            with self._swap_cv:
+                while not self._swap_q and not self._swap_stop:
+                    self._swap_cv.wait()
+                if not self._swap_q:
+                    return  # stopped and drained
+                sid, rows, pos, priority, t_start = self._swap_q.popleft()
+            try:
+                # the sanctioned readback of the spill path: measured so
+                # the serve_swap record carries how much copy time the
+                # next dispatch absorbed
+                with observe_spans.span("serve_swap_spill",
+                                        args={"session": sid}) as scope:
+                    host = {layer: [np.asarray(leaf) for leaf in leaves]
+                            for layer, leaves in rows.items()}
+                overlap_ms = scope.dur * 1e3
+                state = SessionState(sid, host, pos, priority)
+                with self._cv:
+                    discard = sid in self._closing
+                    if discard:
+                        # closed while the spill was in flight: drop
+                        # the carry instead of committing a dead
+                        # conversation's state
+                        self._closing.discard(sid)
+                        self._pending_spills.pop(sid, None)
+                        self._cv.notify_all()
+                if discard:
+                    self._update_session_gauges()
+                    continue
+                evicted = self._store.put(state)
+                with self._cv:
+                    self._stats["spills"] += 1
+                    self._stats["spill_get_ms_sum"] += overlap_ms
+                    self._pending_spills.pop(sid, None)
+                    # close raced in BETWEEN the check above and the
+                    # store commit: honor it by removing what we just
+                    # committed (outside the cv, below)
+                    late_close = sid in self._closing
+                    self._closing.discard(sid)
+                    self._cv.notify_all()
+                if late_close:
+                    try:
+                        self._store.pop(sid)
+                    except (SessionGone, KeyError):
+                        pass
+                self._m_spills.inc()
+                self._m_swap_ms.observe(overlap_ms)
+                self._log_swap("spill", sid, state.nbytes,
+                               overlap_ms=overlap_ms, pos=pos)
+                self._account_evictions(evicted)
+                self._update_session_gauges()
+            except Exception:  # noqa: BLE001 — one lost carry must not kill the writer
+                # a failed device_get (poisoned buffer) or store/sink
+                # error loses THIS carry only: tombstone the session,
+                # release its waiters, keep the writer alive for every
+                # later spill
+                from paddle_tpu.utils.logger import logger
+
+                logger.exception("session spill of %r failed; session "
+                                 "tombstoned", sid)
+                self._store.tombstone(sid, "error")
+                with self._cv:
+                    self._pending_spills.pop(sid, None)
+                    self._closing.discard(sid)
+                    self._cv.notify_all()
+                self._account_evictions(
+                    [SessionState(sid, {}, pos)], reason="error")
+                self._update_session_gauges()
 
     def _distribute(self, outs, lens):
         """Hand each occupied slot its window of outputs; retire and
-        resolve sequences that finished. Returns the retired requests."""
+        resolve sequences that finished (a session's slot parks —
+        carry kept — unless the request closed it). Returns the
+        retired requests."""
         retired = []
+        closed = 0
         t_done = time.perf_counter()
+        now = time.monotonic()
         for i, slot in enumerate(self._slots):
             req, k = slot.req, int(lens[i])
             if req is None or k == 0:
@@ -477,6 +1169,18 @@ class ContinuousScheduler:
             if slot.pos >= req.length:
                 slot.req = None
                 retired.append(req)
+                with self._cv:
+                    ses = slot.session
+                    if ses is not None:
+                        ses.pos += req.length
+                        ses.last_active = now
+                        if req.end_session:
+                            self._detach_locked(i)
+                            closed += 1
+        if closed:
+            with self._cv:
+                self._stats["sessions_closed"] += closed
+            self._update_session_gauges()
         if not retired:
             return retired
         with self._cv:

@@ -37,6 +37,7 @@ import numpy as np
 
 from paddle_tpu.serve.bundle import SEQ_KINDS, flat_keys
 from paddle_tpu.serve.engine import Overloaded
+from paddle_tpu.serve.sessions import SessionGone
 
 
 def _request_arrays(bundle, payload):
@@ -83,19 +84,37 @@ class _BaseHandler(BaseHTTPRequestHandler):
 
     def _run_infer(self, bundle, infer_fn):
         """Shared request body handling: parse, type the arrays against
-        ``bundle``'s manifest, run ``infer_fn(arrays, timeout_s)``,
-        answer JSON — the single-model and routed handlers differ only
-        in the callable."""
+        ``bundle``'s manifest, run ``infer_fn(arrays, timeout_s,
+        session_id, end_session)``, answer JSON — the single-model and
+        routed handlers differ only in the callable. ``session_id`` in
+        the body continues that session's recurrent carry across
+        requests (docs/serving.md "Session tier & paging");
+        ``end_session: true`` closes it with the request."""
         length = int(self.headers.get("Content-Length", "0"))
         payload = json.loads(self.rfile.read(length) or b"{}")
         arrays = _request_arrays(bundle, payload)
-        result = infer_fn(arrays, float(payload.get("timeout_s", 60.0)))
-        self._send(200, {"outputs": {k: np.asarray(v).tolist()
-                                     for k, v in result.items()}})
+        session_id = payload.get("session_id")
+        if session_id is not None:
+            session_id = str(session_id)
+        result = infer_fn(arrays, float(payload.get("timeout_s", 60.0)),
+                          session_id, bool(payload.get("end_session")))
+        body = {"outputs": {k: np.asarray(v).tolist()
+                            for k, v in result.items()}}
+        if session_id is not None:
+            body["session_id"] = session_id
+        self._send(200, body)
 
     def _infer_errors(self, fn):
         try:
             fn()
+        except SessionGone as exc:
+            # explicit gone-semantics for evicted sessions: the carry
+            # was paged out of existence, so the conversation cannot
+            # continue — 410 Gone tells the client to START A NEW
+            # SESSION rather than retry (a retry can never succeed)
+            self._send(410, {"error": str(exc),
+                             "session_id": exc.session_id,
+                             "reason": exc.reason})
         except Overloaded as exc:
             # the fast shed path: tell the client to back off / retry
             # elsewhere BEFORE any queueing happened (429 Too Many
@@ -140,8 +159,20 @@ class _Handler(_BaseHandler):
         if self.path != "/infer":
             self._send(404, {"error": "unknown path %s" % self.path})
             return
+
+        def infer(arrays, timeout, session_id, end_session):
+            if session_id is None:
+                return self.engine.infer(arrays, timeout=timeout)
+            if not getattr(self.engine, "supports_sessions", False):
+                raise ValueError(
+                    "this bundle does not hold sessions (re-export "
+                    "with decode_slots= and serve --continuous)")
+            return self.engine.infer(arrays, timeout=timeout,
+                                     session_id=session_id,
+                                     end_session=end_session)
+
         self._infer_errors(
-            lambda: self._run_infer(self.bundle, self.engine.infer))
+            lambda: self._run_infer(self.bundle, infer))
 
 
 class _RouterHandler(_BaseHandler):
@@ -213,8 +244,10 @@ class _RouterHandler(_BaseHandler):
     def _route(self, hosted):
         self._run_infer(
             hosted.bundle,
-            lambda arrays, timeout: self.router.infer(
-                hosted.name, arrays, timeout=timeout))
+            lambda arrays, timeout, session_id, end_session:
+                self.router.infer(hosted.name, arrays, timeout=timeout,
+                                  session_id=session_id,
+                                  end_session=end_session))
 
 
 def make_server(bundle, engine, host="127.0.0.1", port=0):

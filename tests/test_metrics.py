@@ -112,6 +112,31 @@ def _golden_registry():
                       buckets=(1.0, 5.0, 25.0, 100.0))
     for v in (0.4, 3.0, 3.5, 17.0, 250.0):
         h.observe(v)
+    # session-tier families (docs/serving.md "Session tier & paging"):
+    # spill/restore counters, reason-labeled evictions, the
+    # resident-vs-suspended gauges and the swap-latency histogram
+    reg.counter("paddle_tpu_serve_session_spills_total",
+                help="session carries paged out to the host store",
+                labels={"model": "tagger"}).inc(9)
+    reg.counter("paddle_tpu_serve_session_restores_total",
+                help="session carries paged back into a decode slot",
+                labels={"model": "tagger"}).inc(6)
+    for reason, n in (("capacity", 2), ("ttl", 1)):
+        reg.counter("paddle_tpu_serve_session_evictions_total",
+                    help="sessions evicted from the host store",
+                    labels={"model": "tagger", "reason": reason}).inc(n)
+    reg.gauge("paddle_tpu_serve_session_resident",
+              help="sessions whose carry is in a decode slot",
+              labels={"model": "tagger"}).set(2)
+    reg.gauge("paddle_tpu_serve_session_suspended",
+              help="sessions paged out to the host store",
+              labels={"model": "tagger"}).set(5)
+    sw = reg.histogram("paddle_tpu_serve_session_swap_ms",
+                       help="device<->host carry copy latency per swap",
+                       labels={"model": "tagger"},
+                       buckets=(0.5, 2.0, 10.0))
+    for v in (0.2, 1.1, 6.0):
+        sw.observe(v)
     return reg
 
 
@@ -129,7 +154,9 @@ def test_prometheus_exposition_parses_as_prometheus():
     ``name{labels} value``, histogram bucket counts are cumulative and
     end in +Inf == _count."""
     text = _golden_registry().to_prometheus()
-    buckets, count = [], None
+    # cumulativeness holds PER histogram series: key the bucket runs by
+    # family+labels (the golden now carries two histogram families)
+    buckets, counts = {}, {}
     for line in text.strip().splitlines():
         if line.startswith("#"):
             continue
@@ -137,11 +164,15 @@ def test_prometheus_exposition_parses_as_prometheus():
         float(value)  # parseable sample value
         assert " " not in name
         if "_bucket" in name:
-            buckets.append(int(value))
-        if name == "paddle_tpu_serve_request_latency_ms_count":
-            count = int(value)
-    assert buckets == sorted(buckets)  # cumulative
-    assert buckets[-1] == count == 5   # +Inf bucket == count
+            family = name.split("_bucket", 1)[0]
+            buckets.setdefault(family, []).append(int(value))
+        if name.endswith("_count") or "_count{" in name:
+            counts[name.split("_count", 1)[0]] = int(value)
+    assert buckets  # the golden carries histogram families
+    for family, runs in buckets.items():
+        assert runs == sorted(runs), family  # cumulative
+        assert runs[-1] == counts[family], family  # +Inf == _count
+    assert counts["paddle_tpu_serve_request_latency_ms"] == 5
 
 
 def test_label_escaping():
