@@ -49,6 +49,17 @@ audited set via ``observe/regress.py`` (warn-only by default,
   compiles; emits qps rows for both sides plus audited ``bytes`` /
   ``replicas`` capacity rows.
 
+* ``--mode trace-overhead`` — the request-scoped tracing A/B
+  (docs/observability.md "Request tracing & tail attribution"): the
+  SAME closed-loop load through two identical engines, one with
+  ``PADDLE_TPU_TRACE_SAMPLE=0`` and one sampling at ``--trace-sample``
+  (default 0.1), measurement passes interleaved and best-of-N per side
+  (min-of-N convention). Gates asserted BEFORE any row emits: zero
+  post-warmup compiles on either side (tracing is host-side only), the
+  traced side actually sampled traces, and tracing-on stays within
+  ``--trace-tol-pct`` (default 3%) of tracing-off qps AND p99 — the
+  "observability is free enough to leave on" claim, audited.
+
 * ``--mode sessions`` — the session-tier A/B (docs/serving.md "Session
   tier & paging"): ONE fixed-seed think-time trace with sessions >>
   ``decode_slots`` (each session decodes chunks with think gaps
@@ -889,6 +900,120 @@ def measure_sessions(args):
     return [row_a, row_b]
 
 
+def measure_trace_overhead(args):
+    """The tracing-overhead A/B: identical engines over one bundle,
+    tracing off vs sampling at ``--trace-sample``, driven by the shared
+    closed-loop client loop. Passes are INTERLEAVED (off, on, off, on,
+    ...) so host drift hits both sides equally, and each side keeps its
+    best pass whole — highest sustained qps with THAT pass's p50/p99
+    (min-of-N: shared-host noise only ever slows a pass; folding the
+    metrics independently would publish a pair no pass achieved).
+    Both engines write real steplogs (flush_every=32, the serving
+    default) to a scratch dir, so the traced side pays the full
+    production cost — context mint, phase spans, the sampled
+    ``serve_trace`` records and the always-on exemplar offers."""
+    from paddle_tpu.observe import steplog as observe_steplog
+    from paddle_tpu.observe import tracing as observe_tracing
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine, load_bundle
+
+    bundle_dir = args.bundle or _export_demo_bundle(
+        tempfile.mkdtemp(prefix="serve_trace_"),
+        tuple(int(b) for b in args.batch_sizes.split(",")))
+    bundle = load_bundle(bundle_dir)
+    slog_dir = tempfile.mkdtemp(prefix="serve_trace_slog_")
+
+    def build(tag):
+        return InferenceEngine(
+            bundle, max_latency_ms=args.max_latency_ms,
+            metrics_registry=MetricsRegistry(), warmup=True,
+            steplog=observe_steplog.StepLog(slog_dir, run_name=tag,
+                                            flush_every=32))
+
+    engine_off, engine_on = build("trace_off"), build("trace_on")
+    prev = os.environ.get("PADDLE_TPU_TRACE_SAMPLE")
+
+    def one_pass(engine, rate, rng):
+        if rate > 0:
+            os.environ["PADDLE_TPU_TRACE_SAMPLE"] = repr(rate)
+        else:
+            os.environ.pop("PADDLE_TPU_TRACE_SAMPLE", None)
+        lat, wall_s = run_closed_loop(engine, bundle, args.clients,
+                                      args.requests,
+                                      args.rows_per_request, rng)
+        p50, p99 = _percentiles(lat)
+        return len(lat) / wall_s, p50, p99
+
+    # each side keeps its best pass WHOLE (highest sustained qps, that
+    # pass's own p50/p99 riding along) — folding qps and p99 minima
+    # independently would publish a (qps, p99) pair no real pass
+    # achieved
+    best = {"off": (0.0, float("inf"), float("inf")),
+            "on": (0.0, float("inf"), float("inf"))}
+    sampled_before = observe_tracing.sampled_count()
+    try:
+        with observe_steplog.watch_compiles() as watch:
+            for p in range(args.trace_passes):
+                # same seeded payload stream per (side, pass) pair
+                for side, engine, rate in (
+                        ("off", engine_off, 0.0),
+                        ("on", engine_on, args.trace_sample)):
+                    rng = np.random.RandomState(args.seed + p)
+                    result = one_pass(engine, rate, rng)
+                    if result[0] > best[side][0]:
+                        best[side] = result
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_TRACE_SAMPLE", None)
+        else:
+            os.environ["PADDLE_TPU_TRACE_SAMPLE"] = prev
+        engine_off.stop()
+        engine_on.stop()
+    traced = observe_tracing.sampled_count() - sampled_before
+
+    # gates BEFORE any row emits
+    assert watch.compiles == 0, (
+        "trace-overhead gate FAILED: the measured phase minted %d "
+        "compiles (tracing must be host-side only): %s"
+        % (watch.compiles, watch.events))
+    assert traced > 0, (
+        "trace-overhead gate FAILED: the traced side sampled nothing "
+        "at rate %.3f over %d requests x %d passes"
+        % (args.trace_sample, args.requests, args.trace_passes))
+    qps_off, p50_off, p99_off = best["off"]
+    qps_on, p50_on, p99_on = best["on"]
+    tol = args.trace_tol_pct / 100.0
+    assert qps_on >= qps_off * (1.0 - tol), (
+        "trace-overhead gate FAILED: tracing-on qps %.1f more than "
+        "%.1f%% under tracing-off %.1f"
+        % (qps_on, args.trace_tol_pct, qps_off))
+    assert p99_on <= p99_off * (1.0 + tol), (
+        "trace-overhead gate FAILED: tracing-on p99 %.2fms more than "
+        "%.1f%% over tracing-off %.2fms"
+        % (p99_on, args.trace_tol_pct, p99_off))
+
+    base = {
+        "unit": "qps", "requests": args.requests,
+        "clients": args.clients,
+        "rows_per_request": args.rows_per_request, "seed": args.seed,
+        "passes": args.trace_passes,
+    }
+    row_off = dict(base, metric="serve_trace_off_qps",
+                   value=round(qps_off, 2), p50_ms=p50_off,
+                   p99_ms=p99_off, mode="tracing_off")
+    row_on = dict(base, metric="serve_trace_on_qps",
+                  value=round(qps_on, 2), p50_ms=p50_on, p99_ms=p99_on,
+                  mode="tracing_on", sample_rate=args.trace_sample,
+                  traced=int(traced),
+                  overhead_qps_pct=round(
+                      100.0 * (qps_off - qps_on) / qps_off, 2),
+                  overhead_p99_pct=round(
+                      100.0 * (p99_on - p99_off) / p99_off, 2),
+                  gate_tol_pct=args.trace_tol_pct,
+                  serve_compiles=watch.compiles)
+    return [row_off, row_on]
+
+
 def measure_priority(args):
     """The mixed two-model shed run: high-priority MLP at a sustainable
     rate, low-priority MLP flooded, one Router. Only low may shed; the
@@ -1019,7 +1144,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", default="closed",
                     choices=("closed", "openloop-ab", "priority",
-                             "replicas-ab", "quant-ab", "sessions"))
+                             "replicas-ab", "quant-ab", "sessions",
+                             "trace-overhead"))
     ap.add_argument("--bundle", default="",
                     help="pre-exported bundle dir (default: export the "
                          "mode's demo bundle to a tmp dir)")
@@ -1115,6 +1241,16 @@ def main(argv=None):
                     help="sessions mode gate: the hard-cap side must "
                          "shed >= 1 session on the trace (0 relaxes "
                          "for tiny smoke runs)")
+    # trace-overhead knobs (--mode trace-overhead)
+    ap.add_argument("--trace-sample", type=float, default=0.1,
+                    help="trace-overhead mode: the tracing-on side's "
+                         "PADDLE_TPU_TRACE_SAMPLE rate")
+    ap.add_argument("--trace-passes", type=int, default=3,
+                    help="trace-overhead mode: interleaved measurement "
+                         "passes per side, best kept (min-of-N)")
+    ap.add_argument("--trace-tol-pct", type=float, default=3.0,
+                    help="trace-overhead gate: tracing-on must stay "
+                         "within this % of tracing-off qps AND p99")
     args = ap.parse_args(argv)
     if args.hardcap_queue is None:
         args.hardcap_queue = 2 * args.decode_slots
@@ -1132,6 +1268,8 @@ def main(argv=None):
         return _emit(measure_quant_ab(args), "exp_serve_quant")
     if args.mode == "sessions":
         return _emit(measure_sessions(args), "exp_serve_sessions")
+    if args.mode == "trace-overhead":
+        return _emit(measure_trace_overhead(args), "exp_serve_trace")
     bundle_dir = args.bundle
     if not bundle_dir:
         bundle_dir = _export_demo_bundle(

@@ -36,6 +36,15 @@ syntax:
   set); an unlocked mutation is a data race that only fires under
   serving load.
 
+* **PTA009 span-hygiene** — the request-tracing bug classes
+  (docs/observability.md "Request tracing & tail attribution"): a
+  ``span(...)`` call that is a bare statement or an assignment (the
+  context manager is never entered — the code reads as instrumented
+  while timing nothing), and a ``threading.Thread(target=...)`` whose
+  target closure-captures a trace context instead of taking it by
+  value (``args=`` / a queue item) — closure capture hides the thread
+  hop from the trace lane.
+
 PTA005-008 (unguarded shared state, lock-order inversion, naked
 condition waits, use-after-donate) are the interprocedural concurrency
 and donation checkers — see analyze/concurrency.py; they run through
@@ -89,6 +98,11 @@ CHECKERS = {
     "PTA008": ("use-after-donate",
                "rebind the name from the donating call's results "
                "(x = step(x, ...)) or stop donating the argument"),
+    "PTA009": ("span-hygiene",
+               "enter spans with `with ...span(...):` (a span call that "
+               "is never entered times nothing), and hand trace "
+               "contexts to threads as explicit args=/queue items — "
+               "closure capture hides the hop from the trace lane"),
 }
 
 # Hot-path roots for PTA001, keyed by path suffix. Nested closures
@@ -107,6 +121,9 @@ HOT_PATHS = {
     "serve/router.py": {"submit", "total_queued"},
     "serve/fleet.py": {"submit", "queue_depth", "_eligible",
                        "_route_session"},
+    # request-scoped tracing rides every serving submit/retire: the
+    # sampler and the exemplar reservoir must never sync with a device
+    "observe/tracing.py": {"resolve", "sample", "offer"},
     # the quantized-bundle dequant hook is traced INTO every exported
     # program (serve/export.py), so a stray host sync in it would land
     # on every serving dispatch of every quantized bundle
@@ -611,6 +628,147 @@ def _check_registries(tree, path, findings):
     _RegistryChecker(path, containers, locks, findings).visit(tree)
 
 
+# -- PTA009: span hygiene & trace-context thread handoff ----------------------
+
+# calls that produce a TraceContext (observe/tracing.py): unqualified
+# constructor-ish names plus the module-qualified sampler entry points
+TRACE_CTX_ATTRS = {"mint", "from_traceparent", "child"}
+TRACE_CTX_MODULES = {"tracing", "observe_tracing"}
+# parameter names that ARE a trace context by convention (the serving
+# tier's submit(..., trace=...) signatures)
+TRACE_NAME_HINTS = {"trace", "trace_ctx", "trace_context", "tracectx"}
+
+
+def _is_trace_ctx_value(value):
+    if not isinstance(value, ast.Call):
+        return False
+    func = value.func
+    if _call_name(func) in TRACE_CTX_ATTRS:
+        return True
+    return (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in TRACE_CTX_MODULES
+            and func.attr in {"resolve", "sample"})
+
+
+def _bound_names(fn):
+    """Names bound inside a function body (params, assignments, for
+    targets, with-as, comprehension targets) — the complement of its
+    free variables."""
+    a = fn.args
+    bound = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if getattr(a, "vararg", None):
+        bound.add(a.vararg.arg)
+    if getattr(a, "kwarg", None):
+        bound.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                bound |= _names_in(t)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            bound |= _names_in(node.target)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            bound |= _names_in(node.optional_vars)
+    return bound
+
+
+def _free_reads(fn):
+    """Names read inside ``fn`` that it does not bind itself — its
+    closure captures."""
+    reads = {n.id for n in ast.walk(fn)
+             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+    return reads - _bound_names(fn)
+
+
+class _SpanHygieneChecker:
+    """PTA009 both halves: (a) a ``span(...)`` call that is a bare
+    statement or an assignment target is a context manager that is
+    NEVER ENTERED — it times nothing while reading as if it did;
+    (b) a ``threading.Thread(target=inner)`` whose inner function
+    closure-captures a trace context from the enclosing scope hides a
+    thread hop from the trace lane — contexts must cross threads as
+    explicit ``args=`` (or ride the queue item), the by-value rule the
+    whole serving tier follows (engine request objects, the
+    scheduler's swap-queue tuples)."""
+
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+
+    def check_spans(self, tree):
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _call_name(node.func) == "span"
+                    and (node.args or node.keywords)):
+                continue
+            parent = getattr(node, "_pl_parent", None)
+            if isinstance(parent, ast.Expr):
+                self.findings.append(Finding(
+                    "PTA009", self.path, node.lineno,
+                    "span(...) as a bare statement — the context "
+                    "manager is never entered, so nothing is timed"))
+            elif isinstance(parent, (ast.Assign, ast.AugAssign)):
+                self.findings.append(Finding(
+                    "PTA009", self.path, node.lineno,
+                    "span(...) assigned instead of entered — use "
+                    "`with ...span(...) as scope:`"))
+
+    def check_thread_handoff(self, tree):
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            trace_names = {p for p in _bound_names(fn)
+                           if p in TRACE_NAME_HINTS}
+            local_defs = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.FunctionDef) and node is not fn:
+                    local_defs.setdefault(node.name, node)
+                elif isinstance(node, ast.Assign) \
+                        and _is_trace_ctx_value(node.value):
+                    for t in node.targets:
+                        trace_names |= _names_in(t)
+                elif isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Lambda) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    local_defs[node.targets[0].id] = node.value
+            if not trace_names:
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and _call_name(node.func) == "Thread"):
+                    continue
+                target = next((kw.value for kw in node.keywords
+                               if kw.arg == "target"), None)
+                inner = None
+                if isinstance(target, ast.Lambda):
+                    inner = target
+                elif isinstance(target, ast.Name):
+                    inner = local_defs.get(target.id)
+                if inner is None:
+                    continue
+                explicit = set()
+                for kw in node.keywords:
+                    if kw.arg in ("args", "kwargs"):
+                        explicit |= _names_in(kw.value)
+                captured = (_free_reads(inner) & trace_names) - explicit
+                for name in sorted(captured):
+                    self.findings.append(Finding(
+                        "PTA009", self.path, node.lineno,
+                        "trace context %r captured into a thread via "
+                        "closure — pass it by value (Thread args= or a "
+                        "queue item) so the hop stays explicit" % name))
+
+
+def _check_span_hygiene(tree, path, findings):
+    checker = _SpanHygieneChecker(path, findings)
+    checker.check_spans(tree)
+    checker.check_thread_handoff(tree)
+
+
 # -- driver ------------------------------------------------------------------
 
 def _annotate_parents(tree):
@@ -632,6 +790,7 @@ def _lint_file(source, path):
     _check_jit_callsites(tree, path, findings)
     _check_threads(tree, path, findings)
     _check_registries(tree, path, findings)
+    _check_span_hygiene(tree, path, findings)
     model = concurrency.collect_file_model(tree, path)
     concurrency.check_file(tree, model, findings)
     suppressions = _suppressions(source)

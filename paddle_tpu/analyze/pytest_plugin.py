@@ -18,7 +18,7 @@ Two gates, registered for the whole tier-1 run by tests/conftest.py:
   trajectory equality.
 
 Plus the ``tree_analysis`` session-scoped fixture: ONE full-tree run of
-``lint.lint_tree()`` (all eight checkers including the cross-module
+``lint.lint_tree()`` (all nine checkers including the cross-module
 PTA006 lock graph) shared by every test that asserts on tree-wide
 findings — the concurrency pass over ~120 files runs once per suite,
 not once per test. Mark such tests ``@pytest.mark.analyze_tree``.

@@ -426,6 +426,27 @@ def cmd_serve(args):
     from paddle_tpu.observe import metrics as observe_metrics
     from paddle_tpu.serve import Router, load_bundle
 
+    # SIGTERM (the production stop signal: kubernetes, systemd, a plain
+    # `kill`) must take the SAME graceful path as Ctrl-C: the finally
+    # blocks below stop the engines, which flush/close their steplogs —
+    # without this, a terminated server silently drops up to
+    # flush_every-1 batched serving records (the default handler exits
+    # without running finally OR atexit)
+    import signal
+
+    def _graceful_term(signum, frame):
+        # one-shot: a SECOND SIGTERM during the (possibly slow) drain
+        # must not raise inside the finally block and abort the very
+        # flush this handler exists to guarantee (force-kill remains
+        # available via SIGKILL)
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        raise KeyboardInterrupt
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful_term)
+    except ValueError:
+        pass  # not the main thread (embedded callers): keep the default
+
     if args.model:
         if args.bundle or args.selfcheck:
             print("--model is multi-model mode: drop the positional "
@@ -616,6 +637,20 @@ def cmd_observe(args):
                               % (s.get("resident_sessions", 0),
                                  s.get("suspended_sessions", 0)))
                 print("      session swaps: %s%s%s" % (swaps, rate, counts))
+        if "serve_tail" in run:
+            # tail attribution over the run's sampled serve_trace
+            # records: the phase histogram of the p99 — "p99 is 80%
+            # queue-wait" vs "80% spill-restore" in one line
+            tail = run["serve_tail"]
+            shares = "  ".join(
+                "%s %.1f%%" % (k[:-len("_ms")] if k.endswith("_ms")
+                               else k, v)
+                for k, v in sorted(tail["phases"].items(),
+                                   key=lambda kv: -kv[1]))
+            print("    serve tail attribution (p%g >= %.1f ms, "
+                  "%d of %d traced): %s"
+                  % (tail["q"], tail["threshold_ms"],
+                     tail["tail_requests"], tail["requests"], shares))
     if summary["trace_files"]:
         print("  traces (open in https://ui.perfetto.dev): %s"
               % ", ".join(summary["trace_files"]))

@@ -26,6 +26,12 @@ profiler window). Three pieces behind one package:
 * :mod:`paddle_tpu.observe.regress` — spread-aware bench regression gate
   against the audited ``BENCH_*.json``/``BASELINE.json`` record
   (``PADDLE_TPU_BENCH_GATE=hard`` fails a regressed bench run).
+* :mod:`paddle_tpu.observe.tracing` — request-scoped distributed
+  tracing for the serving tier: W3C-traceparent-shaped
+  :class:`~paddle_tpu.observe.tracing.TraceContext` propagated by value
+  through every thread hop, ``PADDLE_TPU_TRACE_SAMPLE`` sampling, the
+  always-on slowest-N exemplar reservoir (``GET /debug/traces``) and
+  the tail-attribution report (``cli observe``).
 
 Everything degrades to a no-op when profiling is unavailable: spans always
 work (pure host timing), attribution returns None without a usable
@@ -34,7 +40,8 @@ flag.
 """
 
 from paddle_tpu.observe import (attribution, metrics, regress,  # noqa: F401
-                                sentinel, spans, steplog)
+                                sentinel, spans, steplog, tracing)
+from paddle_tpu.observe.tracing import TraceContext  # noqa: F401
 from paddle_tpu.observe.metrics import get_registry  # noqa: F401
 from paddle_tpu.observe.spans import get_tracer, span  # noqa: F401
 from paddle_tpu.observe.steplog import StepLog, from_env, telemetry_dir  # noqa: F401

@@ -342,6 +342,37 @@ class MetricsRegistry:
             self._order = []
 
 
+def build_info(registry=None):
+    """Register (idempotently) the ``paddle_tpu_build_info`` info-gauge:
+    value is always 1, the payload is the label set — ``version``
+    (package), ``jax_version`` and ``schema`` (steplog schema version),
+    so one scrape answers "what exactly is this process running". The
+    serving engines call this from their metric setup; the Prometheus
+    convention for version facts is an info gauge, not N gauges."""
+    reg = registry if registry is not None else _global_registry
+    try:
+        import paddle_tpu
+
+        version = getattr(paddle_tpu, "__version__", "unknown")
+    except Exception:
+        version = "unknown"
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:
+        jax_version = "none"
+    from paddle_tpu.observe.steplog import SCHEMA_VERSION
+
+    g = reg.gauge("paddle_tpu_build_info",
+                  help="build/version info (value is always 1)",
+                  labels={"version": str(version),
+                          "jax_version": str(jax_version),
+                          "schema": str(SCHEMA_VERSION)})
+    g.set(1)
+    return g
+
+
 _global_registry = MetricsRegistry()
 
 

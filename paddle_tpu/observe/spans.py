@@ -17,6 +17,18 @@ holds by construction (a nested span closes before its parent).
 An optional ``sync`` pytree is blocked on (``jax.block_until_ready``)
 before the span closes, so spans timing device work record real wall
 time, not dispatch time.
+
+**Request-scoped tracing** (docs/observability.md "Request tracing &
+tail attribution"): a span may carry a
+:class:`~paddle_tpu.observe.tracing.TraceContext` (``trace=ctx``) —
+the context's trace/span/parent ids land in the span's args, and the
+exporter links every span of one trace into a single flow-arrowed lane
+("s"/"t"/"f" events) across threads, so one request's journey through
+the HTTP thread, the dispatch loop and the spill writer renders as ONE
+connected lane in Perfetto. :meth:`SpanTracer.add_event` records a span
+retrospectively from stamped timestamps — the serving workers measure
+phases as plain perf_counter pairs on the hot path and emit the spans
+once, at request completion.
 """
 
 import json
@@ -58,7 +70,9 @@ class SpanTracer:
         # the trainer/run.py telemetry paths — flip it to True)
         self.record_events = record_events
         self._lock = threading.Lock()
-        self._events = []  # (name, t_start_s, dur_s, thread_ident, args)
+        # (name, t_start_s, dur_s, thread_ident, args, trace) — trace is
+        # (trace_id, span_id, parent_id) or None
+        self._events = []
         self._dropped = 0
         self._stats = stats
         self._t0 = time.perf_counter()
@@ -69,10 +83,12 @@ class SpanTracer:
         return self.record_events
 
     @contextmanager
-    def span(self, name, sync=None, args=None):
+    def span(self, name, sync=None, args=None, trace=None):
         """Time a scope. ``sync`` is an optional array/pytree blocked on
         before the span closes; ``args`` is a small JSON-able dict shown
-        in the trace viewer."""
+        in the trace viewer; ``trace`` is an optional sampled
+        :class:`~paddle_tpu.observe.tracing.TraceContext` linking this
+        span into its request's cross-thread flow lane."""
         scope = _Scope(name)
         start = time.perf_counter()
         try:
@@ -94,13 +110,39 @@ class SpanTracer:
                 if self._stats is not None:
                     self._stats.get(name).add(scope.dur)
                 if self._recording():
-                    with self._lock:
-                        if len(self._events) < self.MAX_EVENTS:
-                            self._events.append(
-                                (name, start - self._t0, scope.dur,
-                                 threading.get_ident(), args))
-                        else:
-                            self._dropped += 1
+                    self._record(name, start, scope.dur,
+                                 threading.get_ident(), args, trace)
+
+    def _record(self, name, t_start, dur, ident, args, trace):
+        """Append one event; ``t_start`` is absolute perf_counter time
+        (made clock-relative under the lock, next to the ``_t0`` that
+        reset() rewrites)."""
+        tup = (None if trace is None or not trace.sampled
+               else (trace.trace_id, trace.span_id, trace.parent_id))
+        with self._lock:
+            if len(self._events) < self.MAX_EVENTS:
+                self._events.append((name, t_start - self._t0, dur,
+                                     ident, args, tup))
+            else:
+                self._dropped += 1
+
+    def add_event(self, name, t_start, dur, args=None, trace=None,
+                  ident=None):
+        """Record a span retrospectively from stamped timestamps
+        (``t_start`` is an absolute ``time.perf_counter()`` value,
+        ``dur`` seconds). The serving workers time request phases as
+        plain perf_counter pairs on the hot path and emit the spans
+        once, at completion — same stats feed, same export, no
+        contextmanager overhead per phase."""
+        dur = max(float(dur), 0.0)
+        if not self.enabled:
+            return
+        if self._stats is not None:
+            self._stats.get(name).add(dur)
+        if self._recording():
+            self._record(name, t_start, dur,
+                         ident if ident is not None
+                         else threading.get_ident(), args, trace)
 
     def instant(self, name, args=None):
         """Record a zero-duration marker (rendered as a thin slice)."""
@@ -121,7 +163,12 @@ class SpanTracer:
 
     def to_chrome_trace(self):
         """Chrome trace-event dict: ``{"traceEvents": [...]}`` with "X"
-        complete events (ts/dur in µs) plus process/thread metadata."""
+        complete events (ts/dur in µs) plus process/thread metadata.
+        Trace-tagged spans additionally carry their trace/span/parent
+        ids in args and are chained per trace_id with flow events
+        ("s" start / "t" step / "f" finish, one flow id per trace) —
+        Perfetto renders each request as one arrow-connected lane no
+        matter how many threads it crossed."""
         pid = os.getpid()
         with self._lock:
             snapshot = list(self._events)
@@ -129,13 +176,36 @@ class SpanTracer:
         out = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
                 "args": {"name": self.name}}]
         tids = {}
-        for name, ts, dur, ident, args in snapshot:
+        flows = {}  # trace_id -> [(ts_s, tid)]
+        for name, ts, dur, ident, args, trace in snapshot:
             tid = tids.setdefault(ident, len(tids))
             ev = {"ph": "X", "name": name, "pid": pid, "tid": tid,
                   "ts": round(ts * 1e6, 3), "dur": round(dur * 1e6, 3)}
-            if args:
-                ev["args"] = dict(args)
+            if args or trace:
+                ev["args"] = dict(args or {})
+            if trace:
+                trace_id, span_id, parent_id = trace
+                ev["args"]["trace_id"] = trace_id
+                ev["args"]["span_id"] = span_id
+                if parent_id:
+                    ev["args"]["parent_id"] = parent_id
+                flows.setdefault(trace_id, []).append((ts, tid))
             out.append(ev)
+        for trace_id, points in flows.items():
+            if len(points) < 2:
+                continue  # a single span needs no arrow
+            points.sort()
+            flow_id = int(trace_id[:15], 16)
+            last = len(points) - 1
+            for i, (ts, tid) in enumerate(points):
+                ev = {"ph": "s" if i == 0 else ("f" if i == last
+                                                else "t"),
+                      "name": "serve_trace", "cat": "serve_trace",
+                      "id": flow_id, "pid": pid, "tid": tid,
+                      "ts": round(ts * 1e6, 3)}
+                if ev["ph"] == "f":
+                    ev["bp"] = "e"  # bind to the enclosing slice
+                out.append(ev)
         for ident, tid in tids.items():
             out.append({"ph": "M", "name": "thread_name", "pid": pid,
                         "tid": tid,
@@ -169,9 +239,9 @@ def get_tracer():
     return _global_tracer
 
 
-def span(name, sync=None, args=None):
+def span(name, sync=None, args=None, trace=None):
     """Module-level shortcut: ``with observe.span("feed"): ...``."""
-    return _global_tracer.span(name, sync=sync, args=args)
+    return _global_tracer.span(name, sync=sync, args=args, trace=trace)
 
 
 def export(path):

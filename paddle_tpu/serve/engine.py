@@ -37,6 +37,7 @@ import numpy as np
 from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.observe import spans as observe_spans
 from paddle_tpu.observe import steplog as observe_steplog
+from paddle_tpu.observe import tracing as observe_tracing
 from paddle_tpu.serve.bundle import flat_keys, pad_rows
 
 
@@ -58,14 +59,19 @@ class Overloaded(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("inputs", "rows", "future", "t_enqueue", "req_id")
+    __slots__ = ("inputs", "rows", "future", "t_enqueue", "req_id",
+                 "trace")
 
-    def __init__(self, inputs, rows, req_id):
+    def __init__(self, inputs, rows, req_id, trace=None):
         self.inputs = inputs
         self.rows = rows
         self.future = Future()
         self.t_enqueue = time.perf_counter()
         self.req_id = req_id
+        # the request's TraceContext (None = unsampled): propagated BY
+        # VALUE across the submit->worker thread hop — the worker emits
+        # this request's phase spans and serve_trace record against it
+        self.trace = trace
 
 
 class InferenceEngine:
@@ -192,6 +198,7 @@ class InferenceEngine:
 
     def _build_metrics(self):
         m, lab = self.metrics, self._labels
+        observe_metrics.build_info(m)
         self._m_requests = m.counter(
             "paddle_tpu_serve_requests_total",
             help="requests completed by the serving engine", labels=lab)
@@ -237,9 +244,14 @@ class InferenceEngine:
             help="device forward time per flushed batch", labels=lab)
 
     # -- client surface -----------------------------------------------------
-    def submit(self, inputs):
+    def submit(self, inputs, trace=None):
         """Enqueue one request (arrays with a leading row dim); returns a
-        Future of {output_name: array[rows, ...]}."""
+        Future of {output_name: array[rows, ...]}. ``trace`` is an
+        optional upstream :class:`~paddle_tpu.observe.tracing
+        .TraceContext` (the HTTP front end mints/adopts one per
+        request); with none the engine itself rolls the
+        ``PADDLE_TPU_TRACE_SAMPLE`` dice, so direct submits trace
+        too."""
         inputs = {k: np.asarray(v) for k, v in inputs.items()}
         if set(inputs) != self._expected_keys:
             raise KeyError(
@@ -270,7 +282,12 @@ class InferenceEngine:
                     model=self.model, reason="queue_full",
                     queued=self._queued_rows)
             self._req_counter += 1
-            req = _Request(inputs, rows, self._req_counter)
+            # the dice rolls only for ADMITTED requests (after the
+            # validation raises and the queue-full shed above), so the
+            # sampled count can never exceed the requests that produce
+            # a serve_trace record
+            req = _Request(inputs, rows, self._req_counter,
+                           trace=observe_tracing.resolve(trace))
             self._queue.append(req)
             self._queued_rows += rows
             self._in_flight += 1
@@ -279,8 +296,8 @@ class InferenceEngine:
             self._cv.notify_all()
         return req.future
 
-    def infer(self, inputs, timeout=60.0):
-        return self.submit(inputs).result(timeout=timeout)
+    def infer(self, inputs, timeout=60.0, trace=None):
+        return self.submit(inputs, trace=trace).result(timeout=timeout)
 
     def queue_depth(self):
         """Rows currently waiting for a batch flush (the router's shed
@@ -310,11 +327,13 @@ class InferenceEngine:
             out["max_latency_ms"] = self.max_latency_ms
         out["ready"] = self.ready()
         out["latency_ms"] = self._m_latency.percentiles()
+        out["trace"] = observe_tracing.trace_state()
         return out
 
     def stop(self, timeout=30.0):
         """Drain the queue, stop the worker, close an engine-owned
-        steplog. Idempotent."""
+        steplog (a shared one is flushed — ``flush_every`` batching
+        must not cost records on an engine stop). Idempotent."""
         with self._cv:
             self._stopped = True
             self._cv.notify_all()
@@ -322,6 +341,8 @@ class InferenceEngine:
         if self._owns_slog and self._slog is not None:
             self._slog.close()
             self._slog = None
+        elif self._slog is not None:
+            self._slog.flush()
 
     def __enter__(self):
         return self
@@ -387,6 +408,11 @@ class InferenceEngine:
                    else np.concatenate([r.inputs[key] for r in requests],
                                        axis=0))
             flat[key] = pad_rows(cat, bucket["batch"])
+        # phase clock for the request-scoped trace (docs/observability
+        # .md "Request tracing & tail attribution"): consecutive
+        # perf_counter stamps so the per-request phases sum EXACTLY to
+        # the enqueue->serialized wall time
+        t_form = time.perf_counter()
         self._batch_counter += 1
         batch_id = self._batch_counter
         with observe_spans.span(
@@ -397,18 +423,52 @@ class InferenceEngine:
         infer_ms = scope.dur * 1e3
         offset = 0
         t_done = time.perf_counter()
+        dispatch_ms = (t_done - t_form) * 1e3
+        form_ms = (t_form - t_start) * 1e3
+        # slice + stamp first, then emit observability, then deliver:
+        # the serialize phase ends at each request's slice (the
+        # steplog/span/exemplar writes are the tracing machinery's own
+        # cost and must not be billed to later batch-mates' serialize
+        # phase), and futures resolve only after every record landed —
+        # a client that wakes from infer() sees its telemetry written
+        sliced = []
         for req in requests:
             result = {k: v[offset:offset + req.rows]
                       for k, v in out.items()}
             offset += req.rows
-            queue_ms = (t_start - req.t_enqueue) * 1e3
-            latency_ms = (t_done - req.t_enqueue) * 1e3
-            if self._slog is not None:
-                self._slog.log_serve_request(
-                    rows=req.rows, queue_ms=queue_ms,
-                    latency_ms=latency_ms, req_id=req.req_id)
-            self._m_queue_ms.observe(queue_ms)
-            self._m_latency.observe(latency_ms)
+            sliced.append((req, result, time.perf_counter()))
+        exemplars = observe_tracing.get_exemplars()
+        for req, _result, t_ser in sliced:
+            # fenced like the scheduler's retire loop: a raising sink
+            # (steplog on a full disk) must lose telemetry, not turn a
+            # computed batch into per-request failures
+            try:
+                queue_ms = (t_start - req.t_enqueue) * 1e3
+                latency_ms = (t_done - req.t_enqueue) * 1e3
+                if self._slog is not None:
+                    self._slog.log_serve_request(
+                        rows=req.rows, queue_ms=queue_ms,
+                        latency_ms=latency_ms, req_id=req.req_id)
+                self._m_queue_ms.observe(queue_ms)
+                self._m_latency.observe(latency_ms)
+                phases = {"queue_ms": queue_ms,
+                          "batch_form_ms": form_ms,
+                          "dispatch_ms": dispatch_ms,
+                          "serialize_ms": (t_ser - t_done) * 1e3}
+                trace_total_ms = (t_ser - req.t_enqueue) * 1e3
+                exemplars.offer(trace_total_ms, phases,
+                                model=self.model, replica=self.replica,
+                                trace_id=(req.trace.trace_id
+                                          if req.trace else None))
+                if req.trace is not None:
+                    self._emit_trace(req, phases, trace_total_ms,
+                                     t_start, t_form, t_done, t_ser)
+            except Exception:  # noqa: BLE001 — lose telemetry, not results
+                from paddle_tpu.utils.logger import logger
+
+                logger.exception("per-request telemetry emission "
+                                 "failed; result still delivered")
+        for req, result, _t_ser in sliced:
             req.future.set_result(result)
         if self._slog is not None:
             self._slog.log_serve_batch(
@@ -447,3 +507,29 @@ class InferenceEngine:
         self.metrics.gauge("paddle_tpu_serve_padding_waste_ratio",
                            help="padding rows / bucket slots (cumulative)",
                            labels=blabel).set(waste / slots)
+
+    def _emit_trace(self, req, phases, latency_ms, t_start, t_form,
+                    t_done, t_ser):
+        """Sampled-request trace emission: the request's phase spans are
+        recorded retrospectively (one child context each, so the
+        exporter flow-links them into the request's lane) plus the
+        ``serve_trace`` steplog record the tail-attribution report
+        aggregates."""
+        ctx = req.trace
+        tracer = observe_spans.get_tracer()
+        args = {"id": req.req_id}
+        tracer.add_event("serve_queue_wait", req.t_enqueue,
+                         t_start - req.t_enqueue, args=args,
+                         trace=ctx.child())
+        tracer.add_event("serve_batch_form", t_start, t_form - t_start,
+                         args=args, trace=ctx.child())
+        tracer.add_event("serve_dispatch", t_form, t_done - t_form,
+                         args=args, trace=ctx.child())
+        tracer.add_event("serve_serialize", t_done, t_ser - t_done,
+                         args=args, trace=ctx.child())
+        if self._slog is not None:
+            self._slog.log_serve_trace(
+                latency_ms=latency_ms, phases=phases,
+                trace_id=ctx.trace_id, span_id=ctx.span_id,
+                model=self.model, replica=self.replica,
+                req_id=req.req_id, rows=req.rows)

@@ -255,7 +255,7 @@ class ReplicaSet:
         return self.continuous
 
     def submit(self, inputs, session_id=None, priority=None,
-               end_session=False):
+               end_session=False, trace=None):
         """Dispatch one request to the least-queued eligible replica
         (round-robin among ties); returns that engine's Future. The
         depth reads are a point-in-time heuristic — two concurrent
@@ -293,7 +293,8 @@ class ReplicaSet:
             return member.engine.submit(inputs,
                                         session_id=str(session_id),
                                         priority=priority,
-                                        end_session=end_session)
+                                        end_session=end_session,
+                                        trace=trace)
         n = len(eligible)
         with self._lock:
             offset = self._rr
@@ -304,7 +305,7 @@ class ReplicaSet:
         order = [eligible[(offset + j) % n] for j in range(n)]
         depths = [m.engine.queue_depth() for m in order]
         best = min(range(n), key=lambda j: (depths[j], j))
-        return order[best].engine.submit(inputs)
+        return order[best].engine.submit(inputs, trace=trace)
 
     def _route_session(self, sid, eligible):
         """The session's target replica: first eligible member in ring
@@ -391,10 +392,10 @@ class ReplicaSet:
             member.engine.close_session(sid)
 
     def infer(self, inputs, timeout=60.0, session_id=None, priority=None,
-              end_session=False):
+              end_session=False, trace=None):
         return self.submit(inputs, session_id=session_id,
-                           priority=priority,
-                           end_session=end_session).result(timeout=timeout)
+                           priority=priority, end_session=end_session,
+                           trace=trace).result(timeout=timeout)
 
     def queue_depth(self):
         """Total queued rows across every replica (the router's
@@ -447,9 +448,13 @@ class ReplicaSet:
 
     def stop(self, timeout=30.0):
         """Stop every replica engine (each drains its own queue and
-        closes its own per-replica steplog). Idempotent."""
+        closes its own per-replica steplog; an explicitly shared log is
+        flushed so flush_every batching cannot drop the last <N
+        records). Idempotent."""
         for m in self._members:
             m.engine.stop(timeout=timeout)
+        if self._slog is not None:
+            self._slog.flush()
 
     def __enter__(self):
         return self

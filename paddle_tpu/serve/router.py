@@ -31,6 +31,7 @@ import threading
 
 from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.observe import steplog as observe_steplog
+from paddle_tpu.observe import tracing as observe_tracing
 from paddle_tpu.serve.engine import Overloaded
 
 # priority classes, strongest first; ``shed_capacity`` maps each to the
@@ -137,14 +138,18 @@ class Router:
                                       priority=hosted.priority,
                                       queued=queued)
 
-    def submit(self, name, inputs, session_id=None, end_session=False):
+    def submit(self, name, inputs, session_id=None, end_session=False,
+               trace=None):
         """Route one request to model ``name``; returns the engine's
         Future. Raises :class:`Overloaded` (fast, before any queue) when
         admission control sheds it. ``session_id`` threads through to
         session-capable engines (the continuous scheduler / fleet) with
         the hosted model's PRIORITY CLASS attached — the session store's
         eviction order is the router's shed order (low pages out
-        first, docs/serving.md "Session tier & paging")."""
+        first, docs/serving.md "Session tier & paging"). ``trace``
+        (a :class:`~paddle_tpu.observe.tracing.TraceContext`) passes
+        through BY VALUE to the hosted engine — the router adds no span
+        of its own, it is a synchronous hop on the caller's thread."""
         hosted = self.model(name)
         ceiling = self.shed_capacity.get(hosted.priority)
         if ceiling is not None:
@@ -166,17 +171,19 @@ class Router:
                 return hosted.engine.submit(inputs,
                                             session_id=session_id,
                                             priority=hosted.priority,
-                                            end_session=end_session)
-            return hosted.engine.submit(inputs)
+                                            end_session=end_session,
+                                            trace=trace)
+            return hosted.engine.submit(inputs, trace=trace)
         except Overloaded as exc:
             exc.priority = hosted.priority
             self._shed(hosted, exc.reason, exc.queued, count=False)
             raise
 
     def infer(self, name, inputs, timeout=60.0, session_id=None,
-              end_session=False):
+              end_session=False, trace=None):
         return self.submit(name, inputs, session_id=session_id,
-                           end_session=end_session).result(timeout=timeout)
+                           end_session=end_session,
+                           trace=trace).result(timeout=timeout)
 
     # -- health -------------------------------------------------------------
     def ready(self):
@@ -210,6 +217,7 @@ class Router:
             "total_queued": self.total_queued(),
             "shed_capacity": dict(self.shed_capacity),
             "ready": self.ready(),
+            "trace": observe_tracing.trace_state(),
         }
 
     def stop(self, timeout=30.0):
@@ -218,6 +226,10 @@ class Router:
         if self._owns_slog and self._slog is not None:
             self._slog.close()
             self._slog = None
+        elif self._slog is not None:
+            # shared log: flush so flush_every batching cannot drop the
+            # last <N shed records on a router stop
+            self._slog.flush()
 
     def __enter__(self):
         return self

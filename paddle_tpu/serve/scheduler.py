@@ -65,6 +65,7 @@ import numpy as np
 from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.observe import spans as observe_spans
 from paddle_tpu.observe import steplog as observe_steplog
+from paddle_tpu.observe import tracing as observe_tracing
 from paddle_tpu.serve.bundle import SEQ_KINDS
 from paddle_tpu.serve.engine import Overloaded
 from paddle_tpu.serve.sessions import SessionGone, SessionState, SessionStore
@@ -73,10 +74,11 @@ from paddle_tpu.serve.sessions import SessionGone, SessionState, SessionStore
 class _DecodeRequest:
     __slots__ = ("data", "length", "future", "t_enqueue", "t_admit",
                  "req_id", "collected", "session", "priority",
-                 "end_session")
+                 "end_session", "trace", "t_defer", "spill_wait_ms",
+                 "restore_ms", "iters")
 
     def __init__(self, data, length, req_id, session=None,
-                 priority=None, end_session=False):
+                 priority=None, end_session=False, trace=None):
         self.data = data          # {input_name: [T, ...] array}
         self.length = length
         self.future = Future()
@@ -87,19 +89,34 @@ class _DecodeRequest:
         self.session = None if session is None else str(session)
         self.priority = priority
         self.end_session = bool(end_session)
+        # request-scoped tracing state (docs/observability.md "Request
+        # tracing & tail attribution"): the TraceContext crosses the
+        # submit->worker hop by value on the request itself; the phase
+        # accumulators below cost a few floats per request and feed the
+        # serve_trace breakdown + the always-on exemplar reservoir
+        self.trace = trace
+        self.t_defer = None       # waiting on its session's spill
+        self.spill_wait_ms = 0.0
+        self.restore_ms = 0.0
+        self.iters = 0            # decode window dispatches spanned
 
 
 class _ResidentSession:
     """A session whose carry lives in the slot matrix (active while its
     request decodes, *parked* between requests)."""
 
-    __slots__ = ("sid", "pos", "priority", "last_active")
+    __slots__ = ("sid", "pos", "priority", "last_active", "trace")
 
     def __init__(self, sid, priority=None, pos=0):
         self.sid = sid
         self.pos = int(pos)
         self.priority = priority or "normal"
         self.last_active = time.monotonic()
+        # the LAST request's TraceContext: a later pressure/idle spill
+        # of this session tags its writer-thread span with it, so the
+        # spill shows up in the lane of the request that parked the
+        # carry (None while the session's requests are unsampled)
+        self.trace = None
 
 
 class _Slot:
@@ -282,6 +299,7 @@ class ContinuousScheduler:
 
     def _build_metrics(self):
         m, lab = self.metrics, self._labels
+        observe_metrics.build_info(m)
         self._m_requests = m.counter(
             "paddle_tpu_serve_requests_total",
             help="requests completed by the serving engine", labels=lab)
@@ -354,12 +372,15 @@ class ContinuousScheduler:
 
     # -- client surface -----------------------------------------------------
     def submit(self, inputs, session_id=None, priority=None,
-               end_session=False):
+               end_session=False, trace=None):
         """Enqueue ONE sequence; returns a Future of
         {output_name: array[T, ...]} (one output row per timestep).
         With ``session_id`` the decode continues that session's carry
         (a new id starts fresh; an EVICTED id raises
-        :class:`SessionGone` — the 410 path)."""
+        :class:`SessionGone` — the 410 path). ``trace`` is an optional
+        upstream :class:`~paddle_tpu.observe.tracing.TraceContext`;
+        with none the scheduler rolls the ``PADDLE_TPU_TRACE_SAMPLE``
+        dice itself."""
         data, length = self._normalize(inputs)
         sid = None if session_id is None else str(session_id)
         if sid is not None:
@@ -384,9 +405,14 @@ class ContinuousScheduler:
                     model=self.model, reason="queue_full",
                     queued=len(self._queue))
             self._req_counter += 1
+            # the dice rolls only for ADMITTED requests (after the
+            # gone-check, normalization raises and the queue-full shed
+            # above), so the sampled count can never exceed the
+            # requests that produce a serve_trace record
             req = _DecodeRequest(data, length, self._req_counter,
                                  session=sid, priority=priority,
-                                 end_session=end_session)
+                                 end_session=end_session,
+                                 trace=observe_tracing.resolve(trace))
             self._queue.append(req)
             self._in_flight += 1
             self._m_queue_depth.set(len(self._queue))
@@ -395,10 +421,10 @@ class ContinuousScheduler:
         return req.future
 
     def infer(self, inputs, timeout=60.0, session_id=None, priority=None,
-              end_session=False):
+              end_session=False, trace=None):
         return self.submit(inputs, session_id=session_id,
-                           priority=priority,
-                           end_session=end_session).result(timeout=timeout)
+                           priority=priority, end_session=end_session,
+                           trace=trace).result(timeout=timeout)
 
     def queue_depth(self):
         with self._cv:
@@ -482,6 +508,7 @@ class ContinuousScheduler:
             out["replica"] = self.replica
         out["ready"] = self.ready()
         out["latency_ms"] = self._m_latency.percentiles()
+        out["trace"] = observe_tracing.trace_state()
         return out
 
     def stop(self, timeout=30.0):
@@ -503,6 +530,10 @@ class ContinuousScheduler:
         if self._owns_slog and self._slog is not None:
             self._slog.close()
             self._slog = None
+        elif self._slog is not None:
+            # shared log: flush so flush_every batching cannot drop the
+            # last <N serving records on a scheduler stop
+            self._slog.flush()
 
     def __enter__(self):
         return self
@@ -827,8 +858,22 @@ class ContinuousScheduler:
                     plan.admitted.append(idx)
                     continue
                 if sid in self._pending_spills:
+                    if req.t_defer is None:
+                        # phase accounting: while the writer commits
+                        # ITS OWN session's spill the request waits on
+                        # the spill, not on a slot — charged to the
+                        # spill_restore phase, not queue-wait
+                        req.t_defer = time.perf_counter()
                     leftovers.append(req)  # writer is mid-commit
                     continue
+                if req.t_defer is not None:
+                    # the spill committed: close the spill-wait
+                    # interval at the FIRST scan that sees it resolved
+                    # — any further waiting (no free slot) is ordinary
+                    # queue-wait and must not inflate spill_restore_ms
+                    req.spill_wait_ms += (time.perf_counter()
+                                          - req.t_defer) * 1e3
+                    req.t_defer = None
                 res_idx = self._session_slots.get(sid)
                 if res_idx is not None:
                     slot = self._slots[res_idx]
@@ -896,6 +941,10 @@ class ContinuousScheduler:
         slot.req = req
         slot.pos = 0
         req.t_admit = time.perf_counter()
+        if req.t_defer is not None:
+            # the wait on the session's own spill commit ends here
+            req.spill_wait_ms += (req.t_admit - req.t_defer) * 1e3
+            req.t_defer = None
         if req.session is not None:
             ses = slot.session
             if ses is None or ses.sid != req.session:
@@ -903,6 +952,7 @@ class ContinuousScheduler:
                 slot.session = ses
                 self._session_slots[req.session] = idx
             ses.last_active = now
+            ses.trace = req.trace
             if req.priority:
                 ses.priority = req.priority
         else:
@@ -982,9 +1032,13 @@ class ContinuousScheduler:
             for idx, ses in plan.spills:
                 rows = self.bundle.carry_slice(self._carry, idx)
                 with self._swap_cv:
+                    # ses.trace rides the queue tuple: the trace context
+                    # crosses the worker->writer thread hop BY VALUE, so
+                    # the writer's spill span lands in the lane of the
+                    # request that parked this carry
                     self._swap_q.append((ses.sid, rows, ses.pos,
                                          ses.priority,
-                                         time.perf_counter()))
+                                         time.perf_counter(), ses.trace))
                     self._swap_cv.notify_all()
                 enqueued += 1
         except Exception:
@@ -1003,10 +1057,20 @@ class ContinuousScheduler:
                     [SessionState(ses.sid, {}, ses.pos)], reason="error")
             raise
         for idx, state in plan.restores:
-            t0 = time.perf_counter()
-            self._carry = self.bundle.carry_insert(self._carry,
-                                                   state.carry, idx)
-            restore_ms = (time.perf_counter() - t0) * 1e3
+            # the restoring request is already attached to the slot
+            # (_plan), so the restore's cost and span are attributed to
+            # ITS trace lane and its spill_restore phase
+            restored_req = self._slots[idx].req
+            ctx = restored_req.trace if restored_req is not None else None
+            with observe_spans.span(
+                    "serve_swap_restore",
+                    args={"session": state.session_id, "slot": idx},
+                    trace=None if ctx is None else ctx.child()) as scope:
+                self._carry = self.bundle.carry_insert(self._carry,
+                                                       state.carry, idx)
+            restore_ms = scope.dur * 1e3
+            if restored_req is not None:
+                restored_req.restore_ms += restore_ms
             with self._cv:
                 self._stats["restores"] += 1
             self._m_restores.inc()
@@ -1046,32 +1110,41 @@ class ContinuousScheduler:
                 self._carry, flat, self.slots)
             outs = {k: np.asarray(v) for k, v in outs.items()}
         infer_ms = scope.dur * 1e3
-        retired = self._distribute(outs, lens)
+        retired, deliveries = self._distribute(outs, lens)
         steps = int(lens.sum())
-        with self._cv:
-            self._stats["iterations"] += 1
-            self._stats["slot_steps"] += steps
-            self._stats["admitted"] += len(plan.admitted)
-            self._stats["retired"] += len(retired)
-            self._stats["iter_ms_sum"] += infer_ms
-            resident = len(self._session_slots)
-        self._m_iters.inc()
-        if steps:
-            self._m_slot_steps.inc(steps)
-        if plan.admitted:
-            self._m_admitted.inc(len(plan.admitted))
-        if retired:
-            self._m_retired.inc(len(retired))
-        self._m_iter_ms.observe(infer_ms)
-        self._m_occupancy.set(active / self.slots)
-        if self._slog is not None:
-            self._slog.log_serve_decode(
-                iteration=self._iter_counter, active=active,
-                window=self.window, slots=self.slots, steps=steps,
-                admitted=len(plan.admitted), retired=len(retired),
-                infer_ms=infer_ms, model=self.model,
-                replica=self.replica, resident=resident,
-                suspended=self._store.suspended_count())
+        try:
+            with self._cv:
+                self._stats["iterations"] += 1
+                self._stats["slot_steps"] += steps
+                self._stats["admitted"] += len(plan.admitted)
+                self._stats["retired"] += len(retired)
+                self._stats["iter_ms_sum"] += infer_ms
+                resident = len(self._session_slots)
+            self._m_iters.inc()
+            if steps:
+                self._m_slot_steps.inc(steps)
+            if plan.admitted:
+                self._m_admitted.inc(len(plan.admitted))
+            if retired:
+                self._m_retired.inc(len(retired))
+            self._m_iter_ms.observe(infer_ms)
+            self._m_occupancy.set(active / self.slots)
+            if self._slog is not None:
+                self._slog.log_serve_decode(
+                    iteration=self._iter_counter, active=active,
+                    window=self.window, slots=self.slots, steps=steps,
+                    admitted=len(plan.admitted), retired=len(retired),
+                    infer_ms=infer_ms, model=self.model,
+                    replica=self.replica, resident=resident,
+                    suspended=self._store.suspended_count())
+        finally:
+            # deliver LAST, and deliver no matter what: a client waking
+            # from infer() finds stats()/steplog already reflecting its
+            # request, and a raising telemetry sink can never strand a
+            # retired (slot-detached) request's future unresolved
+            for req, result, _t_ser in deliveries:
+                if not req.future.done():
+                    req.future.set_result(result)
 
     def _swap_writer_loop(self):
         """The named spill writer: owns the BLOCKING device->host carry
@@ -1083,13 +1156,18 @@ class ContinuousScheduler:
                     self._swap_cv.wait()
                 if not self._swap_q:
                     return  # stopped and drained
-                sid, rows, pos, priority, t_start = self._swap_q.popleft()
+                (sid, rows, pos, priority, t_start,
+                 trace) = self._swap_q.popleft()
             try:
                 # the sanctioned readback of the spill path: measured so
                 # the serve_swap record carries how much copy time the
-                # next dispatch absorbed
-                with observe_spans.span("serve_swap_spill",
-                                        args={"session": sid}) as scope:
+                # next dispatch absorbed; a sampled session's trace
+                # context (handed over on the queue tuple) links this
+                # writer-thread span into the request's flow lane
+                with observe_spans.span(
+                        "serve_swap_spill", args={"session": sid},
+                        trace=None if trace is None
+                        else trace.child()) as scope:
                     host = {layer: [np.asarray(leaf) for leaf in leaves]
                             for layer, leaves in rows.items()}
                 overlap_ms = scope.dur * 1e3
@@ -1147,10 +1225,12 @@ class ContinuousScheduler:
                 self._update_session_gauges()
 
     def _distribute(self, outs, lens):
-        """Hand each occupied slot its window of outputs; retire and
-        resolve sequences that finished (a session's slot parks —
-        carry kept — unless the request closed it). Returns the
-        retired requests."""
+        """Hand each occupied slot its window of outputs; retire
+        sequences that finished (a session's slot parks — carry kept —
+        unless the request closed it) and emit their per-request
+        telemetry. Returns ``(retired requests, deliveries)`` —
+        ``deliveries`` is ``[(request, result, t_serialize)]`` for the
+        CALLER to resolve once the iteration accounting landed."""
         retired = []
         closed = 0
         t_done = time.perf_counter()
@@ -1159,6 +1239,7 @@ class ContinuousScheduler:
             req, k = slot.req, int(lens[i])
             if req is None or k == 0:
                 continue
+            req.iters += 1  # decode dispatches this request spanned
             # copies, not views: a slice of outs would pin the whole
             # [slots, window, ...] iteration array until retirement —
             # a slots-fold memory amplification per in-flight window
@@ -1182,7 +1263,7 @@ class ContinuousScheduler:
                 self._stats["sessions_closed"] += closed
             self._update_session_gauges()
         if not retired:
-            return retired
+            return retired, []
         with self._cv:
             self._in_flight -= len(retired)
             self._m_in_flight.set(self._in_flight)
@@ -1194,18 +1275,89 @@ class ContinuousScheduler:
         # the latency histograms stay per-sample by definition
         self._m_requests.inc(len(retired))
         self._m_rows.inc(len(retired))
+        # concatenate + stamp first, then emit observability; the
+        # FUTURES are resolved by _run_iteration once the iteration's
+        # own accounting landed too. Two reasons: the steplog/span/
+        # exemplar writes are the tracing machinery's own cost and
+        # must not be billed to later retirees' serialize phase, and a
+        # client that wakes from infer() must find stats()/steplog
+        # already reflecting its request (stats-vs-records torn reads)
+        deliveries = []
         for req in retired:
             result = {
                 name: np.concatenate([c[name] for c in req.collected],
                                      axis=0)
                 for name in self._out_names}
-            queue_ms = (req.t_admit - req.t_enqueue) * 1e3
-            latency_ms = (t_done - req.t_enqueue) * 1e3
-            self._m_queue_ms.observe(queue_ms)
-            self._m_latency.observe(latency_ms)
-            if self._slog is not None:
-                self._slog.log_serve_request(
-                    rows=1, queue_ms=queue_ms, latency_ms=latency_ms,
-                    req_id=req.req_id)
-            req.future.set_result(result)
-        return retired
+            deliveries.append((req, result, time.perf_counter()))
+        exemplars = observe_tracing.get_exemplars()
+        for req, _result, t_ser in deliveries:
+            # per-retiree emission is fenced: these requests are
+            # already slot-DETACHED, so a raising sink (steplog on a
+            # full disk, a metrics error) escaping here would strand
+            # their computed results — _loop's failure handler only
+            # covers slot-attached occupants. A telemetry failure
+            # loses telemetry, never results.
+            try:
+                queue_ms = (req.t_admit - req.t_enqueue) * 1e3
+                latency_ms = (t_done - req.t_enqueue) * 1e3
+                self._m_queue_ms.observe(queue_ms)
+                self._m_latency.observe(latency_ms)
+                if self._slog is not None:
+                    self._slog.log_serve_request(
+                        rows=1, queue_ms=queue_ms,
+                        latency_ms=latency_ms, req_id=req.req_id)
+                # request-scoped phase breakdown: consecutive intervals
+                # of enqueue -> serialized result, with the session
+                # tier's spill-wait/restore cost pulled out of
+                # queue/decode so "p99 is 80% spill-restore" is
+                # visible as its own phase
+                phases = {
+                    "queue_ms": max(queue_ms - req.spill_wait_ms, 0.0),
+                    "spill_restore_ms": (req.spill_wait_ms
+                                         + req.restore_ms),
+                    "decode_ms": max((t_done - req.t_admit) * 1e3
+                                     - req.restore_ms, 0.0),
+                    "serialize_ms": (t_ser - t_done) * 1e3,
+                }
+                trace_total_ms = (t_ser - req.t_enqueue) * 1e3
+                exemplars.offer(trace_total_ms, phases,
+                                model=self.model, replica=self.replica,
+                                session=req.session,
+                                trace_id=(req.trace.trace_id
+                                          if req.trace else None))
+                if req.trace is not None:
+                    self._emit_trace(req, phases, trace_total_ms,
+                                     t_done, t_ser)
+            except Exception:  # noqa: BLE001 — lose telemetry, not results
+                from paddle_tpu.utils.logger import logger
+
+                logger.exception("per-request telemetry emission "
+                                 "failed; result still delivered")
+        return retired, deliveries
+
+    def _emit_trace(self, req, phases, latency_ms, t_done, t_ser):
+        """Sampled-request trace emission at retirement: retrospective
+        phase spans (each a child context, flow-linked by the exporter
+        into the request's cross-thread lane) + the ``serve_trace``
+        steplog record the tail-attribution report aggregates."""
+        ctx = req.trace
+        tracer = observe_spans.get_tracer()
+        args = {"id": req.req_id}
+        if req.session is not None:
+            args["session"] = req.session
+        tracer.add_event("serve_queue_wait", req.t_enqueue,
+                         req.t_admit - req.t_enqueue, args=args,
+                         trace=ctx.child())
+        tracer.add_event("serve_decode_seq", req.t_admit,
+                         t_done - req.t_admit,
+                         args=dict(args, iterations=req.iters),
+                         trace=ctx.child())
+        tracer.add_event("serve_serialize", t_done, t_ser - t_done,
+                         args=args, trace=ctx.child())
+        if self._slog is not None:
+            self._slog.log_serve_trace(
+                latency_ms=latency_ms, phases=phases,
+                trace_id=ctx.trace_id, span_id=ctx.span_id,
+                model=self.model, replica=self.replica,
+                req_id=req.req_id, rows=1, iterations=req.iters,
+                session=req.session)

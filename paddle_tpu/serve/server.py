@@ -35,6 +35,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from paddle_tpu.observe import spans as observe_spans
+from paddle_tpu.observe import tracing as observe_tracing
 from paddle_tpu.serve.bundle import SEQ_KINDS, flat_keys
 from paddle_tpu.serve.engine import Overloaded
 from paddle_tpu.serve.sessions import SessionGone
@@ -61,14 +63,17 @@ def _request_arrays(bundle, payload):
 
 
 class _BaseHandler(BaseHTTPRequestHandler):
-    def _send(self, code, obj):
-        self._send_text(code, json.dumps(obj), "application/json")
+    def _send(self, code, obj, headers=None):
+        self._send_text(code, json.dumps(obj), "application/json",
+                        headers=headers)
 
-    def _send_text(self, code, text, content_type):
+    def _send_text(self, code, text, content_type, headers=None):
         body = text.encode()
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -85,26 +90,65 @@ class _BaseHandler(BaseHTTPRequestHandler):
     def _run_infer(self, bundle, infer_fn):
         """Shared request body handling: parse, type the arrays against
         ``bundle``'s manifest, run ``infer_fn(arrays, timeout_s,
-        session_id, end_session)``, answer JSON — the single-model and
-        routed handlers differ only in the callable. ``session_id`` in
-        the body continues that session's recurrent carry across
-        requests (docs/serving.md "Session tier & paging");
-        ``end_session: true`` closes it with the request."""
+        session_id, end_session, trace)``, answer JSON — the
+        single-model and routed handlers differ only in the callable.
+        ``session_id`` in the body continues that session's recurrent
+        carry across requests (docs/serving.md "Session tier &
+        paging"); ``end_session: true`` closes it with the request.
+
+        Request-scoped tracing (docs/observability.md): an inbound W3C
+        ``traceparent`` header is honored (its sampled flag decides),
+        else the front door rolls the ``PADDLE_TPU_TRACE_SAMPLE`` dice
+        ONCE here — :data:`~paddle_tpu.observe.tracing.NOT_SAMPLED`
+        propagates a negative decision so inner layers never re-roll.
+        A sampled request runs inside a ``serve_http`` span and the
+        response echoes ``traceparent`` with the server's span id, so
+        the caller can link its own trace to ours."""
+        # trace context FIRST, before anything that can raise (body
+        # parse included): a sampled request that fails (400/410/429/
+        # 500) must still echo traceparent — the failing requests are
+        # exactly the ones a caller's tracer wants to link;
+        # _infer_errors reads _trace_headers for that
+        ctx = observe_tracing.TraceContext.from_traceparent(
+            self.headers.get("traceparent"))
+        if ctx is None:
+            ctx = observe_tracing.sample() or observe_tracing.NOT_SAMPLED
+        headers = None
+        if ctx.sampled:
+            headers = {"traceparent": ctx.traceparent()}
+            self._trace_headers = headers
         length = int(self.headers.get("Content-Length", "0"))
         payload = json.loads(self.rfile.read(length) or b"{}")
         arrays = _request_arrays(bundle, payload)
         session_id = payload.get("session_id")
         if session_id is not None:
             session_id = str(session_id)
-        result = infer_fn(arrays, float(payload.get("timeout_s", 60.0)),
-                          session_id, bool(payload.get("end_session")))
+        timeout_s = float(payload.get("timeout_s", 60.0))
+        end_session = bool(payload.get("end_session"))
+        if ctx.sampled:
+            # ctx IS the server's own span: from_traceparent minted a
+            # fresh span id parented on the caller's, and mint() a
+            # fresh root — childing again here would parent serve_http
+            # (and the whole lane) on a span nothing ever records
+            with observe_spans.span("serve_http",
+                                    args={"path": self.path},
+                                    trace=ctx):
+                result = infer_fn(arrays, timeout_s, session_id,
+                                  end_session, ctx)
+        else:
+            result = infer_fn(arrays, timeout_s, session_id,
+                              end_session, observe_tracing.NOT_SAMPLED)
         body = {"outputs": {k: np.asarray(v).tolist()
                             for k, v in result.items()}}
         if session_id is not None:
             body["session_id"] = session_id
-        self._send(200, body)
+        self._send(200, body, headers=headers)
 
     def _infer_errors(self, fn):
+        # per-request reset: keep-alive connections reuse this handler
+        # object, and a previous request's trace must never leak onto
+        # the next one's error response
+        self._trace_headers = None
         try:
             fn()
         except SessionGone as exc:
@@ -114,18 +158,22 @@ class _BaseHandler(BaseHTTPRequestHandler):
             # SESSION rather than retry (a retry can never succeed)
             self._send(410, {"error": str(exc),
                              "session_id": exc.session_id,
-                             "reason": exc.reason})
+                             "reason": exc.reason},
+                       headers=self._trace_headers)
         except Overloaded as exc:
             # the fast shed path: tell the client to back off / retry
             # elsewhere BEFORE any queueing happened (429 Too Many
             # Requests, the load-shed status)
             self._send(429, {"error": str(exc), "model": exc.model,
                              "priority": exc.priority,
-                             "reason": exc.reason})
+                             "reason": exc.reason},
+                       headers=self._trace_headers)
         except (ValueError, KeyError) as exc:
-            self._send(400, {"error": str(exc)})
+            self._send(400, {"error": str(exc)},
+                       headers=self._trace_headers)
         except Exception as exc:  # noqa: BLE001 — surface, don't kill the server
-            self._send(500, {"error": str(exc)})
+            self._send(500, {"error": str(exc)},
+                       headers=self._trace_headers)
 
 
 class _Handler(_BaseHandler):
@@ -150,6 +198,11 @@ class _Handler(_BaseHandler):
             self._send_metrics(self.engine.metrics)
         elif self.path == "/stats":
             self._send(200, self.engine.stats())
+        elif self.path == "/debug/traces":
+            # the always-on tail surface: sampling state + the
+            # slowest-N per-request phase breakdowns (works at sample
+            # rate 0 — exemplars are collected for every request)
+            self._send(200, observe_tracing.debug_traces())
         elif self.path == "/manifest":
             self._send(200, self.bundle.manifest)
         else:
@@ -160,16 +213,18 @@ class _Handler(_BaseHandler):
             self._send(404, {"error": "unknown path %s" % self.path})
             return
 
-        def infer(arrays, timeout, session_id, end_session):
+        def infer(arrays, timeout, session_id, end_session, trace):
             if session_id is None:
-                return self.engine.infer(arrays, timeout=timeout)
+                return self.engine.infer(arrays, timeout=timeout,
+                                         trace=trace)
             if not getattr(self.engine, "supports_sessions", False):
                 raise ValueError(
                     "this bundle does not hold sessions (re-export "
                     "with decode_slots= and serve --continuous)")
             return self.engine.infer(arrays, timeout=timeout,
                                      session_id=session_id,
-                                     end_session=end_session)
+                                     end_session=end_session,
+                                     trace=trace)
 
         self._infer_errors(
             lambda: self._run_infer(self.bundle, infer))
@@ -206,6 +261,8 @@ class _RouterHandler(_BaseHandler):
             self._send_metrics(router.metrics)
         elif self.path == "/stats":
             self._send(200, router.stats())
+        elif self.path == "/debug/traces":
+            self._send(200, observe_tracing.debug_traces())
         elif self.path == "/manifest":
             try:
                 self._send(200, router.default_model().bundle.manifest)
@@ -244,10 +301,10 @@ class _RouterHandler(_BaseHandler):
     def _route(self, hosted):
         self._run_infer(
             hosted.bundle,
-            lambda arrays, timeout, session_id, end_session:
+            lambda arrays, timeout, session_id, end_session, trace:
                 self.router.infer(hosted.name, arrays, timeout=timeout,
                                   session_id=session_id,
-                                  end_session=end_session))
+                                  end_session=end_session, trace=trace))
 
 
 def make_server(bundle, engine, host="127.0.0.1", port=0):

@@ -205,13 +205,13 @@ def test_suppression_comment():
 @pytest.mark.analyze_tree
 def test_checked_in_tree_lints_clean(tree_analysis):
     """THE gate: the shipped source tree has zero findings across all
-    eight checkers (PTA001-008 incl. the cross-module lock graph) —
+    nine checkers (PTA001-009 incl. the cross-module lock graph) —
     real hazards are fixed, false positives carry inline suppressions.
     The session-scoped tree_analysis fixture runs the full-tree pass
     ONCE suite-wide."""
     findings, n_files = tree_analysis["findings"], tree_analysis["files"]
     assert n_files > 100
-    assert len(lint.CHECKERS) == 8
+    assert len(lint.CHECKERS) == 9
     assert findings == [], "\n".join(
         lint.format_finding(f) for f in findings)
 
@@ -424,6 +424,67 @@ def test_pta008_decode_step_callsite():
     assert _ids(findings) == ["PTA008"]
     fixed = src.replace("c2, outs", "carry, outs")
     assert lint.lint_source(fixed, "m.py") == []
+
+
+# ---- PTA009: span hygiene & trace-context thread handoff -------------------
+
+def test_pta009_span_not_entered():
+    """A span(...) that is a bare statement or an assignment never
+    enters the context manager — it times nothing."""
+    src = (
+        "from paddle_tpu.observe import spans as observe_spans\n"
+        "def work():\n"
+        "    observe_spans.span('feed')\n"
+        "    s = observe_spans.span('step')\n"
+    )
+    findings = lint.lint_source(src, "m.py")
+    assert _ids(findings) == ["PTA009", "PTA009"]
+    assert "never entered" in findings[0].message
+    # the entered form and the factory (return) form are both clean
+    good = (
+        "from paddle_tpu.observe import spans as observe_spans\n"
+        "def work():\n"
+        "    with observe_spans.span('feed') as scope:\n"
+        "        pass\n"
+        "    return observe_spans.span('outer')\n"
+    )
+    assert lint.lint_source(good, "m.py") == []
+
+
+def test_pta009_trace_context_closure_capture():
+    """A trace context must cross a thread BY VALUE (Thread args= or a
+    queue item), never via closure capture."""
+    src = (
+        "import threading\n"
+        "from paddle_tpu.observe import tracing as observe_tracing\n"
+        "def serve(trace):\n"
+        "    ctx = observe_tracing.resolve(trace)\n"
+        "    def worker():\n"
+        "        use(ctx)\n"
+        "    t = threading.Thread(target=worker, name='w')\n"
+    )
+    findings = lint.lint_source(src, "m.py")
+    assert _ids(findings) == ["PTA009"]
+    assert "'ctx'" in findings[0].message
+    # the explicit-handoff form is clean: ctx passed via args=
+    fixed = (
+        "import threading\n"
+        "from paddle_tpu.observe import tracing as observe_tracing\n"
+        "def serve(trace):\n"
+        "    ctx = observe_tracing.resolve(trace)\n"
+        "    def worker(c):\n"
+        "        use(c)\n"
+        "    t = threading.Thread(target=worker, name='w',\n"
+        "                         args=(ctx,))\n"
+    )
+    assert lint.lint_source(fixed, "m.py") == []
+    # a trace-named PARAMETER captured into a lambda target also flags
+    src2 = (
+        "import threading\n"
+        "def serve(trace):\n"
+        "    t = threading.Thread(target=lambda: use(trace), name='w')\n"
+    )
+    assert _ids(lint.lint_source(src2, "m.py")) == ["PTA009"]
 
 
 def test_new_ids_suppressible():

@@ -137,6 +137,13 @@ def _golden_registry():
                        buckets=(0.5, 2.0, 10.0))
     for v in (0.2, 1.1, 6.0):
         sw.observe(v)
+    # the build-info info-gauge (value is always 1, the payload is the
+    # label set) — fixed label values here; live engines stamp the real
+    # versions through observe.metrics.build_info()
+    reg.gauge("paddle_tpu_build_info",
+              help="build/version info (value is always 1)",
+              labels={"version": "0.1.0", "jax_version": "0.9",
+                      "schema": "1"}).set(1)
     return reg
 
 
@@ -494,3 +501,80 @@ def test_trainer_updates_train_metrics():
         "paddle_tpu_train_examples_total").value == examples0 + 16
     assert np.isfinite(reg.gauge("paddle_tpu_train_loss").value)
     assert reg.gauge("paddle_tpu_train_examples_per_sec").value > 0
+
+
+def test_build_info_gauge_registered_by_engine(mlp_bundle):
+    """Every serving engine registers the build-info info-gauge: value
+    1, the payload is the label set (version / jax_version / schema)."""
+    import jax
+
+    import paddle_tpu
+    from paddle_tpu.serve import InferenceEngine
+
+    reg = metrics.MetricsRegistry()
+    with InferenceEngine(mlp_bundle, metrics_registry=reg,
+                         warmup=False):
+        pass
+    line = [l for l in reg.to_prometheus().splitlines()
+            if l.startswith("paddle_tpu_build_info")][0]
+    assert line.endswith(" 1")
+    assert 'version="%s"' % paddle_tpu.__version__ in line
+    assert 'jax_version="%s"' % jax.__version__ in line
+    assert 'schema="1"' in line
+
+
+def test_concurrent_scrapes_during_fleet_burst(mlp_bundle):
+    """The scrape contract under load: N scraper threads rendering the
+    exposition while a 2-replica fleet serves a burst — no exceptions,
+    no torn exposition (every line parses), and the requests counter is
+    monotone across successive scrapes."""
+    from paddle_tpu.serve import ReplicaSet
+
+    reg = metrics.MetricsRegistry()
+    errors, stop = [], threading.Event()
+
+    def scraper():
+        last = -1.0
+        while not stop.is_set():
+            try:
+                text = reg.to_prometheus()
+                seen = None
+                for line in text.strip().splitlines():
+                    if line.startswith("#"):
+                        continue
+                    name, value = line.rsplit(" ", 1)
+                    float(value)  # parseable: no torn lines
+                    assert " " not in name
+                    if name.startswith(
+                            "paddle_tpu_serve_requests_total"):
+                        seen = (seen or 0.0) + float(value)
+                if seen is not None:
+                    if seen < last:
+                        errors.append("requests_total went backwards: "
+                                      "%s < %s" % (seen, last))
+                    last = seen
+            except Exception as exc:  # noqa: BLE001 — the assertion below reports
+                errors.append(repr(exc))
+                return
+
+    with ReplicaSet(mlp_bundle, replicas=2,
+                    metrics_registry=reg) as fleet:
+        scrapers = [threading.Thread(target=scraper,
+                                     name="metrics-scraper-%d" % i)
+                    for i in range(3)]
+        for t in scrapers:
+            t.start()
+        rng = np.random.RandomState(0)
+        futures = [fleet.submit(
+            {"pixel": rng.randn(1, 784).astype(np.float32)})
+            for _ in range(40)]
+        for f in futures:
+            f.result(timeout=120)
+        stop.set()
+        for t in scrapers:
+            t.join(timeout=30)
+    assert errors == [], errors
+    counters = reg.snapshot()["counters"]
+    total = sum(v for k, v in counters.items()
+                if k.startswith("paddle_tpu_serve_requests_total"))
+    assert total == 40
