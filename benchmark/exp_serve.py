@@ -41,6 +41,19 @@ audited set via ``observe/regress.py`` (warn-only by default,
   width (``--replicas-min-speedup`` overrides; the row records both
   the gate used and the core count so the audit sees the derating).
 
+* ``--mode workers-ab`` — the multi-process data-plane A/B
+  (serve/workers.py, docs/serving.md "Worker processes"): the SAME
+  seeded request population against an in-process :class:`ReplicaSet`
+  and a multi-process :class:`WorkerSet` at matched width, plus a
+  single-scheduler capacity baseline. Gates asserted BEFORE any row
+  emits: 1e-6 equivalence through EVERY worker process, zero
+  post-warmup compiles inside any worker (the in-worker
+  ``watch_compiles`` reading over control RPC), the shm ring never
+  drops a request, and sustained qps >= ``0.9 x min(workers, cores)``
+  (capped at the 3.6x acceptance bar) vs the single scheduler —
+  informational on hosts below 2 cores, with the derate recorded in
+  the row (``--workers-min-speedup`` overrides).
+
 * ``--mode quant-ab`` — the quantized-bundle A/B (docs/serving.md
   "Quantized bundles"): one set of mlp parameters exported fp AND
   int8, gated on accuracy (argmax agreement + bounded logit drift),
@@ -521,6 +534,158 @@ def measure_replicas_ab(args):
                  p99_tol=round(p99_tol, 1),
                  warmup_compiles=w_fleet.compiles,
                  serve_compiles=w_serve.compiles)
+    return [row_a, row_b]
+
+
+def measure_workers_ab(args):
+    """The multi-process data-plane A/B (docs/serving.md "Worker
+    processes"): the same seeded request population against an
+    in-process :class:`ReplicaSet` and a multi-process
+    :class:`WorkerSet` at MATCHED replica count over the same tagger
+    bundle, with a single-scheduler capacity baseline for the scaling
+    gate. Gates asserted BEFORE any row emits:
+
+    1. equivalence — the probe sequence through EVERY worker process
+       matches the single scheduler to 1e-6;
+    2. zero post-warmup compiles in any worker (the in-worker
+       ``watch_compiles`` reading over control RPC, diffed across the
+       measured phase);
+    3. the ring never drops — every dispatched request completes and
+       nothing sheds during the measured burst;
+    4. scaling — sustained qps >= 0.9x ideal (``0.9 x min(workers,
+       cores)``, capped at the 4-worker acceptance bar 3.6x) vs the
+       single scheduler. A host without at least 2 cores cannot
+       honestly demonstrate multi-process scaling, so the gate derates
+       to informational there; the derate is recorded in the row
+       (``gate_speedup``/``cpu_count``). ``--workers-min-speedup``
+       pins an explicit bar, 0 disables.
+    """
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import (ContinuousScheduler, ReplicaSet,
+                                  load_bundle)
+    from paddle_tpu.serve.workers import WorkerSet
+
+    bundle_dir = args.bundle or _export_tagger_bundle(
+        tempfile.mkdtemp(prefix="serve_tagger_"),
+        tuple(int(b) for b in args.batch_sizes.split(",")),
+        args.seq_len, args.decode_slots, args.decode_window, args.hidden)
+    bundle = load_bundle(bundle_dir)
+    out_name = bundle.outputs[0]["name"]
+    n = args.workers
+    _, seqs = arrival_trace(args.requests, args.arrival_qps, args.seed,
+                            args.mean_len, bundle.seq_len)
+    burst = np.zeros(len(seqs))
+
+    def capacity(submit_fn):
+        best = 0.0
+        for _ in range(args.capacity_passes):
+            _, _, drops, done = drive_open_loop(submit_fn, burst)
+            assert drops == 0, "capacity burst shed %d requests" % drops
+            best = max(best, sustained_qps(done))
+        return best
+
+    # baseline: ONE in-process scheduler — the denominator of the
+    # scaling gate and the numeric reference for the equivalence gate
+    single = ContinuousScheduler(bundle,
+                                 metrics_registry=MetricsRegistry(),
+                                 model="tagger", max_queue=None)
+    probe = seqs[0]
+    want = single.infer({"word": probe}, timeout=600.0)[out_name]
+    qps_single = capacity(lambda i: single.submit({"word": seqs[i]}))
+    offered = 0.6 * qps_single
+    lat_rng = np.random.RandomState(args.seed + 1)
+    lat_arrivals = np.cumsum(lat_rng.exponential(1.0 / offered,
+                                                 size=len(seqs)))
+    single.stop()
+
+    # A: the in-process replica fleet at width n (the PR 12 shape —
+    # N engines, ONE interpreter, so router + engines share the GIL)
+    fleet = ReplicaSet(bundle, replicas=n, continuous=True,
+                       metrics_registry=MetricsRegistry(),
+                       model="tagger",
+                       engine_kwargs={"max_queue": None}, warmup=True)
+    qps_replicas = capacity(lambda i: fleet.submit({"word": seqs[i]}))
+    lat_a, _, _, _ = drive_open_loop(
+        lambda i: fleet.submit({"word": seqs[i]}), lat_arrivals)
+    fleet.stop()
+
+    # B: the multi-process worker fleet at the SAME width
+    workers = WorkerSet(bundle, workers=n, continuous=True,
+                        engine_kwargs={"max_queue": None},
+                        metrics_registry=MetricsRegistry(),
+                        model="tagger")
+    try:
+        workers.wait_ready(timeout=600.0)
+        # gate 1: probe through EVERY worker process, 1e-6 vs single
+        for index in range(n):
+            got = workers.submit_to(index, {"word": probe}).result(
+                timeout=600.0)[out_name]
+            np.testing.assert_allclose(
+                got, want, atol=1e-6,
+                err_msg="worker %d diverges from the single scheduler"
+                        % index)
+        compiles_before = workers.compile_counts()
+        qps_workers = capacity(lambda i: workers.submit(
+            {"word": seqs[i]}))
+        lat_b, _, _, _ = drive_open_loop(
+            lambda i: workers.submit({"word": seqs[i]}), lat_arrivals)
+        compiles_after = workers.compile_counts()
+        wstats = workers.stats()
+    finally:
+        workers.stop()
+    # gate 2: the measured phase minted zero compiles in any worker
+    assert compiles_after == compiles_before, (
+        "worker dispatch minted post-warmup compiles: %r -> %r"
+        % (compiles_before, compiles_after))
+    # gate 3: the ring never drops — every dispatch completed, no sheds
+    router = wstats["router"]
+    assert router["completed"] == router["dispatched"], (
+        "ring dropped requests: %d dispatched vs %d completed"
+        % (router["dispatched"], router["completed"]))
+    assert wstats.get("shed", 0) == 0, (
+        "worker engines shed %d requests during the measured burst"
+        % wstats.get("shed", 0))
+
+    # gate 4: scaling vs the single scheduler, derated to the host
+    cores = os.cpu_count() or 1
+    ideal = min(n, cores)
+    min_speedup = args.workers_min_speedup
+    if min_speedup < 0:
+        min_speedup = min(3.6, 0.9 * ideal) if ideal >= 2 else 0.0
+    speedup = qps_workers / qps_single
+    if min_speedup > 0:
+        assert speedup >= min_speedup, (
+            "worker scaling gate FAILED: %.2fx sustained qps "
+            "(%.1f vs %.1f at %d workers), need >= %.2fx"
+            % (speedup, qps_workers, qps_single, n, min_speedup))
+
+    p50_a, p99_a = _percentiles(lat_a)
+    p50_b, p99_b = _percentiles(lat_b)
+    base = {
+        "unit": "qps", "requests": args.requests,
+        "offered_qps": round(offered, 1), "seed": args.seed,
+        "mean_len": args.mean_len, "seq_len": bundle.seq_len,
+        "arrivals": "burst_capacity+poisson_latency",
+        "lengths": "lognormal_s0.8",
+        "cpu_count": cores, "hidden": args.hidden,
+        "slots": args.decode_slots, "window": args.decode_window,
+        "single_qps": round(qps_single, 2),
+    }
+    row_a = dict(base, metric="serve_replicaset_tagger_qps",
+                 value=round(qps_replicas, 2),
+                 p50_ms=p50_a, p99_ms=p99_a,
+                 mode="inprocess_replicas", replicas=n,
+                 speedup_vs_single=round(qps_replicas / qps_single, 2))
+    row_b = dict(base, metric="serve_workerset_tagger_qps",
+                 value=round(qps_workers, 2),
+                 p50_ms=p50_b, p99_ms=p99_b,
+                 mode="worker_processes", workers=n,
+                 transport="shm_ring",
+                 speedup_vs_single=round(speedup, 2),
+                 speedup_vs_replicas=round(
+                     qps_workers / max(qps_replicas, 1e-9), 2),
+                 gate_speedup=round(min_speedup, 2),
+                 serve_compiles=0)
     return [row_a, row_b]
 
 
@@ -1144,8 +1309,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", default="closed",
                     choices=("closed", "openloop-ab", "priority",
-                             "replicas-ab", "quant-ab", "sessions",
-                             "trace-overhead"))
+                             "replicas-ab", "workers-ab", "quant-ab",
+                             "sessions", "trace-overhead"))
     ap.add_argument("--bundle", default="",
                     help="pre-exported bundle dir (default: export the "
                          "mode's demo bundle to a tmp dir)")
@@ -1191,6 +1356,15 @@ def main(argv=None):
                          "scheduler per device; force devices with "
                          "XLA_FLAGS=--xla_force_host_platform_device_"
                          "count=N)")
+    ap.add_argument("--workers", type=int, default=4,
+                    help="workers-ab: worker-process fleet width (one "
+                         "OS process per replica)")
+    ap.add_argument("--workers-min-speedup", type=float, default=-1.0,
+                    help="workers-ab gate: the worker fleet must "
+                         "sustain >= this x the single-scheduler qps "
+                         "(0 disables; -1 = auto: the 3.6x acceptance "
+                         "bar, derated to 0.9 x min(workers, cpu "
+                         "cores), informational below 2 cores)")
     ap.add_argument("--capacity-passes", type=int, default=2,
                     help="replicas-ab: burst passes per side, best "
                          "kept (min-of-N convention — shared-host "
@@ -1264,6 +1438,8 @@ def main(argv=None):
         return _emit(measure_priority(args), "exp_serve_priority")
     if args.mode == "replicas-ab":
         return _emit(measure_replicas_ab(args), "exp_serve_replicas")
+    if args.mode == "workers-ab":
+        return _emit(measure_workers_ab(args), "exp_serve_workers")
     if args.mode == "quant-ab":
         return _emit(measure_quant_ab(args), "exp_serve_quant")
     if args.mode == "sessions":

@@ -121,6 +121,13 @@ HOT_PATHS = {
     "serve/router.py": {"submit", "total_queued"},
     "serve/fleet.py": {"submit", "queue_depth", "_eligible",
                        "_route_session"},
+    # the multi-process data plane's ring + dispatch: put/get run per
+    # request per direction inside the busy-poll window, and the
+    # router-side submit/rx paths sit on every cross-process request —
+    # a host sync here stalls the whole worker fleet
+    "serve/workers.py": {"put_frames", "get", "submit", "_submit_to",
+                         "_eligible", "_route_session", "_rx_loop",
+                         "_dispatch_response", "queue_depth"},
     # request-scoped tracing rides every serving submit/retire: the
     # sampler and the exemplar reservoir must never sync with a device
     "observe/tracing.py": {"resolve", "sample", "offer"},

@@ -378,6 +378,35 @@ def _make_engine(bundle, args, reg, model=None, warmup="async",
               file=sys.stderr)
         raise SystemExit(2)
     replicas = getattr(args, "replicas", "") or ""
+    workers = getattr(args, "workers", "") or ""
+    if workers and replicas:
+        print("--workers (worker processes) and --replicas (in-process "
+              "threads) are mutually exclusive: pick one data plane",
+              file=sys.stderr)
+        raise SystemExit(2)
+    if workers:
+        # multi-process data plane (docs/serving.md "Worker
+        # processes"): each replica as its own OS worker process behind
+        # the same duck-typed fleet front — the GIL-free path
+        from paddle_tpu.serve import WorkerSet
+        from paddle_tpu.serve.fleet import auto_replicas
+
+        # "auto" sizes like --replicas auto (one per device, or the
+        # manifest-HBM count under PADDLE_TPU_HBM_BUDGET) and then caps
+        # at the host's core count — worker PROCESSES beyond the cores
+        # only add context-switch overhead, never throughput
+        n = (min(auto_replicas(bundle, budget=budget_share),
+                 os.cpu_count() or 1)
+             if workers == "auto" else int(workers))
+        kwargs = (dict({"max_queue": args.max_queue_rows},
+                       **_session_kwargs(args)) if args.continuous
+                  else {"max_batch_size": args.max_batch_size,
+                        "max_latency_ms": args.max_latency_ms,
+                        "max_queue_rows": args.max_queue_rows})
+        return WorkerSet(bundle, workers=max(n, 1),
+                         continuous=args.continuous,
+                         engine_kwargs=kwargs, metrics_registry=reg,
+                         model=model, respawn=args.respawn_workers)
     if replicas:
         # replica scaling (docs/serving.md "Replica scaling"): ONE
         # bundle onto N devices as N shared-nothing engines behind a
@@ -505,6 +534,10 @@ def cmd_serve(args):
                           warmup=(True if args.selfcheck else "async"))
     if args.selfcheck:
         try:
+            if hasattr(engine, "wait_ready"):
+                # worker fleet: warmup runs inside the child processes;
+                # the smoke gate waits for every worker to report warm
+                engine.wait_ready(timeout=300.0)
             out = engine.infer(bundle.dummy_inputs(rows=1), timeout=300.0)
             print(json.dumps({
                 "ok": True, "bundle": bundle.name,
@@ -617,10 +650,15 @@ def cmd_observe(args):
         if "cost_last" in run:
             print("    cost: first %.6f -> last %.6f"
                   % (run["cost_first"], run["cost_last"]))
+        # a WorkerSet's per-worker steplog file carries the worker
+        # index in its meta: label its lines "worker" so per-worker
+        # qps/occupancy reads next to the in-process per-replica lines
+        member = ("worker" if run.get("serve_worker") is not None
+                  else "replica")
         for rep, s in sorted(run.get("serve_replicas", {}).items()):
-            print("    serve replica %-4s dispatches %-6d "
+            print("    serve %s %-4s dispatches %-6d "
                   "completed %-6d%s%s"
-                  % (rep, s["dispatches"], s["completed"],
+                  % (member, rep, s["dispatches"], s["completed"],
                      ("  qps %.1f" % s["qps"]) if "qps" in s else "",
                      ("  occupancy %.2f" % s["occupancy_mean"])
                      if "occupancy_mean" in s else ""))
@@ -962,6 +1000,19 @@ def main(argv=None):
                         "estimate fits, so quantized bundles admit "
                         "more); /metrics gains {replica=} labels, "
                         "/readyz is all-replicas-warm")
+    p.add_argument("--workers", default="",
+                   help="N|auto: run each replica as its own OS worker "
+                        "process behind the fleet front (GIL-free data "
+                        "plane; mutually exclusive with --replicas). "
+                        "Rows cross process boundaries over a shared-"
+                        "memory ring; auto sizes like --replicas auto "
+                        "capped at the host core count; workers write "
+                        "<run>-w<i>.steps.jsonl steplogs and /metrics "
+                        "merges worker snapshots under {worker=} labels")
+    p.add_argument("--respawn-workers", action="store_true",
+                   help="--workers: restart a dead worker process in "
+                        "place (crash-only serving; sessions re-home "
+                        "from their last committed carry backup)")
     p.add_argument("--selfcheck", action="store_true",
                    help="load, warm, run one batch, exit (smoke gate)")
     p.add_argument("--host", default="127.0.0.1")

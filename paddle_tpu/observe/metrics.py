@@ -332,6 +332,31 @@ class MetricsRegistry:
                     out["histograms"][key] = entry
         return out
 
+    def dump_series(self):
+        """JSON-able dump of every family — the cross-process transfer
+        format: a serving worker process ships this over the control
+        RPC and the router re-renders it (with an injected ``worker``
+        label) through :func:`merged_exposition`, so one ``/metrics``
+        scrape covers the whole multi-process fleet. Histograms travel
+        as (count, sum, cumulative buckets); values stay exact."""
+        out = []
+        for name, kind, help, instruments in self._families():
+            series = []
+            for inst in instruments:
+                if kind == "histogram":
+                    count, total, cumulative = inst.state()
+                    series.append({
+                        "labels": dict(inst.labels),
+                        "count": count, "sum": total,
+                        "buckets": [[b, c] for b, c in
+                                    zip(inst.buckets, cumulative)]})
+                else:
+                    series.append({"labels": dict(inst.labels),
+                                   "value": inst.value})
+            out.append({"name": name, "kind": kind, "help": help,
+                        "series": series})
+        return out
+
     def reset(self):
         """Drop every instrument (tests only — live instruments held by
         callers keep working but detach from the exposition)."""
@@ -340,6 +365,72 @@ class MetricsRegistry:
             self._kinds.clear()
             self._helps.clear()
             self._order = []
+
+
+def merged_exposition(registry, extras=()):
+    """Prometheus text exposition of ``registry`` merged with remote
+    :meth:`MetricsRegistry.dump_series` snapshots.
+
+    ``extras`` is ``[(families_dump, extra_labels), ...]`` — each dump
+    typically one worker process's registry, each ``extra_labels``
+    typically ``{"worker": "<i>"}``. Families merge by name (local
+    registration order first, dump-only families appended in arrival
+    order); series within a family sort by label set, the same stable
+    order :meth:`MetricsRegistry.to_prometheus` renders, and with no
+    extras the output is byte-identical to ``to_prometheus()`` (pinned
+    by the exposition golden's merged variant)."""
+    import collections
+
+    families = collections.OrderedDict()
+
+    def _add(dump, extra_labels=None):
+        for fam in dump:
+            entry = families.setdefault(
+                fam["name"], {"kind": fam["kind"],
+                              "help": fam.get("help", ""),
+                              "series": []})
+            if entry["kind"] != fam["kind"]:
+                continue  # cross-process kind clash: first wins
+            if not entry["help"] and fam.get("help"):
+                entry["help"] = fam["help"]
+            for series in fam.get("series", ()):
+                labels = dict(series.get("labels") or {})
+                if extra_labels:
+                    labels.update(extra_labels)
+                entry["series"].append(dict(series, labels=labels))
+
+    _add(registry.dump_series())
+    for dump, extra_labels in extras:
+        _add(dump, extra_labels)
+    lines = []
+    for name, entry in families.items():
+        if entry["help"]:
+            lines.append("# HELP %s %s"
+                         % (name, entry["help"].replace("\n", " ")))
+        lines.append("# TYPE %s %s" % (name, entry["kind"]))
+        ordered = sorted(entry["series"],
+                         key=lambda s: tuple(sorted(s["labels"].items())))
+        for series in ordered:
+            labels = series["labels"]
+            if entry["kind"] == "histogram":
+                for bound, c in series["buckets"]:
+                    lines.append("%s_bucket%s %s" % (
+                        name,
+                        _labels_suffix(labels, {"le": _fmt(float(bound))}),
+                        int(c)))
+                lines.append("%s_bucket%s %s" % (
+                    name, _labels_suffix(labels, {"le": "+Inf"}),
+                    int(series["count"])))
+                lines.append("%s_sum%s %s" % (
+                    name, _labels_suffix(labels),
+                    _fmt(float(series["sum"]))))
+                lines.append("%s_count%s %s" % (
+                    name, _labels_suffix(labels), int(series["count"])))
+            else:
+                lines.append("%s%s %s" % (
+                    name, _labels_suffix(labels),
+                    _fmt(float(series["value"]))))
+    return "\n".join(lines) + "\n"
 
 
 def build_info(registry=None):

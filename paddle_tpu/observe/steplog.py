@@ -851,6 +851,12 @@ def summarize_dir(directory):
         serve = _serve_replica_summary(records)
         if serve:
             run["serve_replicas"] = serve
+        if meta.get("worker") is not None:
+            # per-worker steplog file of a multi-process WorkerSet
+            # (<run>-w<i>.steps.jsonl): surface the worker index so
+            # `cli observe` prints per-worker qps/occupancy next to the
+            # per-replica lines
+            run["serve_worker"] = meta.get("worker")
         traced = [r for r in records if r.get("type") == "serve_trace"]
         if traced:
             from paddle_tpu.observe.tracing import tail_attribution

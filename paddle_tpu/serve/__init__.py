@@ -29,6 +29,13 @@ Three pillars (docs/serving.md):
   replicas behind a least-queued dispatch front; duck-typed like an
   engine so the Router/HTTP front door host it unchanged
   (``cli serve --replicas N|auto``).
+* :class:`WorkerSet` (serve/workers.py) — the multi-process data
+  plane: each replica as its own OS worker process (bundle loaded
+  once per worker, device pinned per worker, ``spawn`` start method)
+  behind the same duck-typed fleet front; rows cross process
+  boundaries over a shared-memory request/response ring with one
+  memcpy and zero pickling, control traffic over a pipe RPC
+  (``cli serve --workers N|auto``).
 * :func:`generate` (serve/generate.py) — streaming generation: a
   host-side loop over the exported decode step feeding y_t back as
   x_{t+1} (``cli generate``).
@@ -51,6 +58,7 @@ from paddle_tpu.serve.router import Router
 from paddle_tpu.serve.scheduler import ContinuousScheduler
 from paddle_tpu.serve.sessions import (ConsistentHashRing, SessionGone,
                                        SessionStore)
+from paddle_tpu.serve.workers import WorkerSet
 
 
 def __getattr__(name):
@@ -65,5 +73,5 @@ def __getattr__(name):
 __all__ = ["Bundle", "BundleReplica", "ConsistentHashRing",
            "ContinuousScheduler", "InferenceEngine", "Overloaded",
            "ReplicaSet", "Router", "SessionGone", "SessionStore",
-           "export_bundle", "generate", "is_bundle", "load_bundle",
-           "verify_bundle"]
+           "WorkerSet", "export_bundle", "generate", "is_bundle",
+           "load_bundle", "verify_bundle"]

@@ -145,6 +145,27 @@ def test_pta003_unnamed_thread():
     assert lint.lint_source(src_ok, "m.py") == []
 
 
+def test_pta003_catches_unnamed_worker_heartbeat_thread():
+    """The worker-fleet bug class (serve/workers.py): a WorkerSet-style
+    class starting its heartbeat monitor as an anonymous thread —
+    exactly the thread a stuck-fleet stack dump must be able to name."""
+    src = (
+        "import threading\n"
+        "class WorkerSet:\n"
+        "    def __init__(self):\n"
+        "        self._hb = threading.Thread(\n"
+        "            target=self._heartbeat_loop, daemon=True)\n"
+        "        self._hb.start()\n"
+        "    def _heartbeat_loop(self):\n"
+        "        pass\n"
+    )
+    findings = lint.lint_source(src, "workers.py")
+    assert _ids(findings) == ["PTA003"]
+    named = src.replace(
+        "daemon=True", "daemon=True, name='serve-worker-heartbeat'")
+    assert lint.lint_source(named, "workers.py") == []
+
+
 def test_pta004_unlocked_registry():
     src = (
         "import threading\n"

@@ -83,8 +83,13 @@ def test_registry_get_or_create_is_process_wide():
     assert metrics.get_registry() is metrics.get_registry()
 
 
-def _golden_registry():
-    """The deterministic registry the golden exposition pins."""
+def _golden_registry(include_workers=True):
+    """The deterministic registry the golden exposition pins.
+
+    ``include_workers=False`` leaves out the ``{worker=}``-labeled
+    series — the merged-exposition test re-creates those from per-
+    worker ``dump_series`` snapshots instead (the WorkerSet ``/metrics``
+    path) and must land on the same golden bytes."""
     reg = metrics.MetricsRegistry()
     c = reg.counter("paddle_tpu_serve_requests_total",
                     help="requests completed by the serving engine")
@@ -96,6 +101,15 @@ def _golden_registry():
         reg.counter("paddle_tpu_serve_requests_total",
                     help="requests completed by the serving engine",
                     labels={"model": model}).inc(n)
+    if include_workers:
+        # worker-process series (serve/workers.py): a WorkerSet's
+        # router merges each worker's registry dump under an injected
+        # {worker=} label — pinned here as locally-registered series
+        for worker, n in (("0", 5), ("1", 4)):
+            reg.counter("paddle_tpu_serve_requests_total",
+                        help="requests completed by the serving engine",
+                        labels={"model": "tagger",
+                                "worker": worker}).inc(n)
     reg.counter("paddle_tpu_serve_shed_total",
                 help="requests rejected by admission control",
                 labels={"model": "tagger", "priority": "low",
@@ -103,6 +117,10 @@ def _golden_registry():
     g = reg.gauge("paddle_tpu_serve_queue_depth",
                   help="rows waiting for a batch flush")
     g.set(3)
+    if include_workers:
+        reg.gauge("paddle_tpu_serve_queue_depth",
+                  help="rows waiting for a batch flush",
+                  labels={"worker": "1"}).set(2)
     for bucket, fill in (("4", 0.75), ("8", 0.5)):
         reg.gauge("paddle_tpu_serve_batch_fill_ratio",
                   help="real rows / bucket slots (cumulative)",
@@ -180,6 +198,33 @@ def test_prometheus_exposition_parses_as_prometheus():
         assert runs == sorted(runs), family  # cumulative
         assert runs[-1] == counts[family], family  # +Inf == _count
     assert counts["paddle_tpu_serve_request_latency_ms"] == 5
+
+
+def test_merged_exposition_reconstructs_golden_from_worker_dumps():
+    """The WorkerSet ``/metrics`` path: the router registry merged with
+    per-worker ``dump_series`` snapshots under injected ``{worker=}``
+    labels must render byte-identically to the same series registered
+    locally — i.e. land on the same golden. With no extras the merged
+    renderer is byte-identical to ``to_prometheus()``."""
+    base = _golden_registry(include_workers=False)
+    w0 = metrics.MetricsRegistry()
+    w0.counter("paddle_tpu_serve_requests_total",
+               help="requests completed by the serving engine",
+               labels={"model": "tagger"}).inc(5)
+    w1 = metrics.MetricsRegistry()
+    w1.counter("paddle_tpu_serve_requests_total",
+               help="requests completed by the serving engine",
+               labels={"model": "tagger"}).inc(4)
+    w1.gauge("paddle_tpu_serve_queue_depth",
+             help="rows waiting for a batch flush").set(2)
+    got = metrics.merged_exposition(
+        base, [(w0.dump_series(), {"worker": "0"}),
+               (w1.dump_series(), {"worker": "1"})])
+    assert got == open(GOLDEN).read()
+    full = _golden_registry()
+    assert metrics.merged_exposition(full, []) == full.to_prometheus()
+    # the dump itself is JSON-able (it crosses the control RPC)
+    json.loads(json.dumps(full.dump_series()))
 
 
 def test_label_escaping():

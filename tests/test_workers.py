@@ -1,0 +1,565 @@
+"""Multi-process serving data plane tests (docs/serving.md "Worker
+processes") — the ISSUE 16 acceptance surface:
+
+* **wire codec**: frame encode/decode round-trips arrays bitwise (zero
+  pickling), including non-contiguous inputs; session-carry state
+  crosses the boundary bitwise through the same codec.
+* **ShmRing**: wraparound over a small ring, oversize messages rejected
+  loudly, a full ring (dead consumer) surfaces ``TimeoutError`` instead
+  of wedging the producer; slot size derives from the manifest.
+* **equivalence**: a 1-worker fleet matches the in-process engine to
+  1e-6 (tier-1 smoke); the slow suite pushes the same probe through
+  EVERY worker of a 2-worker fleet and pins zero post-warmup compiles
+  per worker via the in-worker ``watch_compiles`` reading.
+* **sessions**: consistent-hash affinity, explicit cross-process
+  carry migration (export over RPC -> import) continues bitwise, and
+  ``kill -9`` of a session's home re-homes the conversation from the
+  router's committed-carry backup with zero committed chunks lost.
+* **failure + shutdown**: a worker killed mid-burst is excluded from
+  dispatch and its in-flight requests re-route; ``respawn=True``
+  revives the slot; SIGTERM of ``cli serve --workers`` leaves no
+  orphan children and no leaked ``/dev/shm`` segments.
+
+Subprocess-heavy cases are marked ``slow``; the tier-1 smoke keeps one
+spawned worker in the default run.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+
+# -- bundle fixtures ---------------------------------------------------------
+
+def _mlp_bundle(tmp, name="mnist_mlp"):
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.vision import mlp
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve import load_bundle
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = mlp(hidden=(16, 8))
+    params = Parameters.create(out)
+    bundle_dir = str(tmp / (name + "_bundle"))
+    export_bundle(out, params, bundle_dir, batch_sizes=(1, 4), name=name)
+    return load_bundle(bundle_dir)
+
+
+def _tagger_bundle(tmp):
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.text import sequence_tagging_gru
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve import load_bundle
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = sequence_tagging_gru(dict_size=50, label_size=5, emb_size=8,
+                               hidden=12)
+    params = Parameters.create(out)
+    bundle_dir = str(tmp / "tagger_bundle")
+    export_bundle(out, params, bundle_dir, batch_sizes=(1,), seq_len=32,
+                  name="tagger", decode_slots=(2,), decode_window=4)
+    return load_bundle(bundle_dir)
+
+
+@pytest.fixture(scope="module")
+def mlp_bundle(tmp_path_factory):
+    return _mlp_bundle(tmp_path_factory.mktemp("workers_mlp"))
+
+
+@pytest.fixture(scope="module")
+def decode_bundle(tmp_path_factory):
+    return _tagger_bundle(tmp_path_factory.mktemp("workers_tagger"))
+
+
+def _seq(n, seed=0, vocab=50):
+    return (np.random.RandomState(seed)
+            .randint(0, vocab, size=(n,)).astype(np.int32))
+
+
+def _pixels(seed=0, rows=1):
+    return (np.random.default_rng(seed)
+            .normal(size=(rows, 784)).astype(np.float32))
+
+
+def _no_leaked_shm():
+    return [p for p in glob.glob("/dev/shm/ptpu-%d-*" % os.getpid())]
+
+
+# -- wire codec --------------------------------------------------------------
+
+class TestFrameCodec:
+    def test_roundtrip_bitwise(self):
+        from paddle_tpu.serve.workers import decode_buffer, encode_frames
+
+        arrays = [
+            np.arange(12, dtype=np.int32).reshape(3, 4),
+            np.random.default_rng(0).normal(size=(2, 5))
+            .astype(np.float32),
+            np.array([3.5], dtype=np.float64),
+        ]
+        header = {"id": 7, "inputs": ["a", "b", "c"], "session": "s1"}
+        frames, nbytes = encode_frames(header, arrays)
+        buf = b"".join(bytes(f) for f in frames)
+        assert len(buf) == nbytes
+        got_header, got = decode_buffer(buf)
+        assert got_header["id"] == 7
+        assert got_header["inputs"] == ["a", "b", "c"]
+        assert "arrays" not in got_header  # specs consumed by decode
+        assert len(got) == len(arrays)
+        for a, b in zip(arrays, got):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert np.array_equal(a, b)
+
+    def test_non_contiguous_input(self):
+        from paddle_tpu.serve.workers import decode_buffer, encode_frames
+
+        base = np.arange(48, dtype=np.float32).reshape(6, 8)
+        sliced = base[::2, 1::3]  # non-contiguous view
+        assert not sliced.flags["C_CONTIGUOUS"]
+        frames, _ = encode_frames({"id": 1, "inputs": ["x"]}, [sliced])
+        _, got = decode_buffer(b"".join(bytes(f) for f in frames))
+        assert np.array_equal(got[0], sliced)
+
+    def test_session_state_roundtrip_bitwise(self):
+        from paddle_tpu.serve.sessions import SessionState
+        from paddle_tpu.serve.workers import (decode_buffer, decode_state,
+                                              encode_frames, encode_state)
+
+        rng = np.random.default_rng(3)
+        carry = {
+            "gru_1": [rng.normal(size=(12,)).astype(np.float32)],
+            "gru_0": [rng.normal(size=(12,)).astype(np.float32),
+                      rng.normal(size=(12,)).astype(np.float32)],
+        }
+        state = SessionState("sess-a", carry, pos=7, priority="low")
+        header, arrays = encode_state(state)
+        # push through the full wire path, not just the dict
+        frames, _ = encode_frames(dict(header, ok=True), arrays)
+        got_header, got_arrays = decode_buffer(
+            b"".join(bytes(f) for f in frames))
+        restored = decode_state("sess-a", got_header, got_arrays)
+        assert restored.session_id == "sess-a"
+        assert restored.pos == 7
+        assert restored.priority == "low"
+        assert sorted(restored.carry) == sorted(carry)
+        for layer, leaves in carry.items():
+            assert len(restored.carry[layer]) == len(leaves)
+            for a, b in zip(leaves, restored.carry[layer]):
+                assert np.array_equal(a, b), "carry must restore bitwise"
+
+    def test_error_mapping_roundtrip(self):
+        from paddle_tpu.serve.engine import Overloaded
+        from paddle_tpu.serve.sessions import SessionGone
+        from paddle_tpu.serve.workers import _error_header, _raise_error
+
+        over = Overloaded("queue full", model="m", priority="low",
+                          reason="pressure", queued=9)
+        with pytest.raises(Overloaded) as exc:
+            _raise_error(_error_header(over))
+        assert exc.value.reason == "pressure"
+        assert exc.value.model == "m"
+        assert exc.value.queued == 9
+
+        gone = SessionGone("bye", session_id="s", reason="ttl")
+        with pytest.raises(SessionGone) as exc:
+            _raise_error(_error_header(gone))
+        assert exc.value.session_id == "s"
+        assert exc.value.reason == "ttl"
+
+        with pytest.raises(ValueError, match="bad feed"):
+            _raise_error(_error_header(ValueError("bad feed")))
+        # unknown exception types degrade to RuntimeError by value
+        with pytest.raises(RuntimeError, match="ZeroDivisionError"):
+            _raise_error(_error_header(ZeroDivisionError("boom")))
+
+
+# -- the shared-memory ring --------------------------------------------------
+
+class TestShmRing:
+    def _pair(self, slots=4, slot_bytes=4096):
+        import multiprocessing as mp
+
+        from paddle_tpu.serve.workers import ShmRing
+
+        ctx = mp.get_context("spawn")
+        data_evt, space_evt = ctx.Event(), ctx.Event()
+        ring = ShmRing(None, slots, slot_bytes, data_evt, space_evt,
+                       create=True)
+        return ring
+
+    def test_wraparound_bitwise(self):
+        from paddle_tpu.serve.workers import decode_buffer, encode_frames
+
+        ring = self._pair(slots=4)
+        try:
+            for i in range(10):  # > 2x the slot count: exercises wrap
+                arr = np.full((5,), i, dtype=np.int64)
+                frames, nbytes = encode_frames({"id": i}, [arr])
+                ring.put_frames(frames, nbytes)
+                buf = ring.get(timeout=1.0)
+                assert buf is not None
+                header, arrays = decode_buffer(buf)
+                assert header["id"] == i
+                assert np.array_equal(arrays[0], arr)
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_oversize_message_rejected(self):
+        from paddle_tpu.serve.workers import encode_frames
+
+        ring = self._pair(slot_bytes=4096)
+        try:
+            frames, nbytes = encode_frames(
+                {"id": 0}, [np.zeros(4096, dtype=np.float64)])
+            with pytest.raises(ValueError, match="exceeds the ring slot"):
+                ring.put_frames(frames, nbytes)
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_full_ring_times_out_loudly(self):
+        from paddle_tpu.serve.workers import encode_frames
+
+        ring = self._pair(slots=2)
+        try:
+            frames, nbytes = encode_frames({"id": 0}, [])
+            ring.put_frames(frames, nbytes)
+            ring.put_frames(frames, nbytes)
+            # nobody consuming: a dead peer must surface, not wedge
+            with pytest.raises(TimeoutError, match="ring full"):
+                ring.put_frames(frames, nbytes, timeout=0.3)
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_empty_ring_get_returns_none(self):
+        ring = self._pair()
+        try:
+            assert ring.get(timeout=0.05) is None
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_slot_bytes_from_manifest(self, mlp_bundle):
+        from paddle_tpu.serve.workers import ring_slot_bytes
+
+        nbytes = ring_slot_bytes(mlp_bundle)
+        assert nbytes % 4096 == 0, "slot size must stay page-rounded"
+        # must hold the largest request: max bucket (4) x 784 float32
+        assert nbytes >= 4 * 784 * 4
+        # fixed-capacity: deterministic for a given manifest
+        assert nbytes == ring_slot_bytes(mlp_bundle)
+
+
+# -- tier-1 fleet smoke (one spawned worker) ---------------------------------
+
+def test_worker_set_smoke(mlp_bundle):
+    """One spawned worker: cold fleet sheds ``no_replica``, the warm
+    fleet matches the in-process engine to 1e-6, metrics carry the
+    ``worker`` label, readiness aggregates, stop leaks nothing."""
+    from paddle_tpu.serve import InferenceEngine
+    from paddle_tpu.serve.engine import Overloaded
+    from paddle_tpu.serve.workers import WorkerSet
+
+    feed = mlp_bundle.inputs[0]["name"]
+    x = _pixels(seed=0)
+    ref = InferenceEngine(mlp_bundle, warmup=True)
+    want = ref.infer({feed: x}, timeout=60.0)
+    ref.stop()
+
+    ws = WorkerSet(mlp_bundle, workers=1, model="mnist_mlp")
+    try:
+        # the worker process is still importing/warming: dispatch must
+        # shed with the fleet reason, not block or crash
+        with pytest.raises(Overloaded) as exc:
+            ws.submit({feed: x})
+        assert exc.value.reason == "no_replica"
+
+        ws.wait_ready(timeout=300.0)
+        assert ws.ready() and ws.live()
+        assert ws.ready_detail() == {"0": True}
+        got = ws.infer({feed: x}, timeout=120.0)
+        assert sorted(got) == sorted(want)
+        for key in want:
+            np.testing.assert_allclose(got[key], want[key], atol=1e-6)
+
+        expo = ws.metrics.to_prometheus()
+        assert 'worker="0"' in expo, \
+            "router /metrics must merge worker-labelled series"
+        stats = ws.stats()
+        assert stats["router"]["dispatched"] >= 1
+        assert stats["router"]["completed"] >= 1
+    finally:
+        ws.stop()
+    ws.stop()  # idempotent
+    assert not ws._handles[0].process.is_alive(), "no orphan child"
+    assert _no_leaked_shm() == [], "no leaked /dev/shm segments"
+
+
+# -- slow suite: multi-worker, kill -9, respawn, cli ------------------------
+
+@pytest.mark.slow
+def test_equivalence_through_every_worker(mlp_bundle):
+    """The workers-ab gate shape: the same probe through EVERY worker
+    matches the in-process engine to 1e-6, and the post-warmup burst
+    mints zero compiles in any worker (in-worker watch_compiles)."""
+    from paddle_tpu.serve import InferenceEngine
+    from paddle_tpu.serve.workers import WorkerSet
+
+    feed = mlp_bundle.inputs[0]["name"]
+    probes = {rows: _pixels(seed=rows, rows=rows) for rows in (1, 4)}
+    ref = InferenceEngine(mlp_bundle, warmup=True)
+    want = {rows: ref.infer({feed: x}, timeout=60.0)
+            for rows, x in probes.items()}
+    ref.stop()
+
+    with WorkerSet(mlp_bundle, workers=2, model="mnist_mlp") as ws:
+        ws.wait_ready(timeout=300.0)
+        for index in range(2):
+            for rows, x in probes.items():
+                got = ws.submit_to(index, {feed: x}).result(timeout=120.0)
+                for key in want[rows]:
+                    np.testing.assert_allclose(
+                        got[key], want[rows][key], atol=1e-6,
+                        err_msg="worker %d rows %d" % (index, rows))
+
+        before = ws.compile_counts()
+        assert sorted(before) == [0, 1]
+        for i in range(8):
+            rows = (i % 4) + 1
+            got = ws.infer({feed: _pixels(seed=100 + i, rows=rows)},
+                           timeout=120.0)
+            assert got
+        after = ws.compile_counts()
+        assert after == before, \
+            "post-warmup burst must mint zero compiles per worker"
+        stats = ws.stats()
+        assert stats["router"]["completed"] >= 12
+    assert _no_leaked_shm() == []
+
+
+@pytest.mark.slow
+def test_session_migrates_across_processes_bitwise(decode_bundle):
+    """Affinity pins a session to its home worker; an explicit
+    cross-process migration (export over RPC -> import) continues the
+    conversation bitwise-equal to the whole-sequence decode."""
+    from paddle_tpu.serve import ContinuousScheduler
+    from paddle_tpu.serve.workers import WorkerSet
+
+    seq = _seq(12, seed=9)
+    ref = ContinuousScheduler(decode_bundle, warmup=True)
+    whole = ref.submit({"word": seq}).result(timeout=120.0)["gru_tag_out"]
+    ref.stop()
+
+    with WorkerSet(decode_bundle, workers=2, continuous=True,
+                   model="tagger") as ws:
+        ws.wait_ready(timeout=300.0)
+        assert ws.supports_sessions
+
+        first = ws.submit({"word": seq[:6]}, session_id="mig").result(
+            timeout=120.0)["gru_tag_out"]
+        home = ws._session_home["mig"]
+        ws.submit({"word": seq[:1]}, session_id="other").result(
+            timeout=120.0)
+        assert ws._session_home["mig"] == home, "affinity must hold"
+
+        target = ws._handles[1 - home]
+        ws._migrate("mig", home, target)
+        assert ws._session_home["mig"] == target.index
+        second = ws.submit({"word": seq[6:]}, session_id="mig").result(
+            timeout=120.0)["gru_tag_out"]
+        assert np.array_equal(np.concatenate([first, second]), whole), \
+            "migrated session must continue bitwise"
+        assert ws.stats()["router"]["migrations"] >= 1
+    assert _no_leaked_shm() == []
+
+
+@pytest.mark.slow
+def test_kill9_home_rehomes_session_from_backup(decode_bundle):
+    """kill -9 the home of a mid-conversation session: the heartbeat
+    detects death, the session re-homes from the router's committed
+    carry backup, and the continuation stays bitwise — zero committed
+    chunks lost."""
+    from paddle_tpu.serve import ContinuousScheduler
+    from paddle_tpu.serve.workers import WorkerSet
+
+    seq = _seq(12, seed=9)
+    ref = ContinuousScheduler(decode_bundle, warmup=True)
+    whole = ref.submit({"word": seq}).result(timeout=120.0)["gru_tag_out"]
+    ref.stop()
+
+    with WorkerSet(decode_bundle, workers=2, continuous=True,
+                   model="tagger") as ws:
+        ws.wait_ready(timeout=300.0)
+        first = ws.submit({"word": seq[:6]}, session_id="victim").result(
+            timeout=120.0)["gru_tag_out"]
+        home = ws._session_home["victim"]
+        os.kill(ws._handles[home].process.pid, signal.SIGKILL)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if not ws.live_detail()[str(home)]:
+                break
+            time.sleep(0.05)
+        assert not ws.live_detail()[str(home)], "death not detected"
+        assert ws.live(), "the survivor keeps the fleet live"
+        assert not ws.ready_detail()[str(home)]
+
+        second = ws.submit({"word": seq[6:]}, session_id="victim").result(
+            timeout=120.0)["gru_tag_out"]
+        assert ws._session_home["victim"] != home
+        assert np.array_equal(np.concatenate([first, second]), whole), \
+            "committed session lost bits after kill -9"
+        assert ws.stats()["router"]["backup_restores"] >= 1
+    assert _no_leaked_shm() == []
+
+
+@pytest.mark.slow
+def test_kill9_mid_burst_reroutes_inflight(mlp_bundle):
+    """kill -9 one worker while a burst is in flight: every future
+    still resolves with the correct value (re-routed to survivors) and
+    the dead worker leaves the dispatch set."""
+    from paddle_tpu.serve import InferenceEngine
+    from paddle_tpu.serve.workers import WorkerSet
+
+    feed = mlp_bundle.inputs[0]["name"]
+    xs = [_pixels(seed=200 + i) for i in range(12)]
+    ref = InferenceEngine(mlp_bundle, warmup=True)
+    want = [ref.infer({feed: x}, timeout=60.0) for x in xs]
+    ref.stop()
+
+    with WorkerSet(mlp_bundle, workers=2, model="mnist_mlp") as ws:
+        ws.wait_ready(timeout=300.0)
+        futures = [ws.submit({feed: x}) for x in xs]
+        os.kill(ws._handles[0].process.pid, signal.SIGKILL)
+        for fut, expect in zip(futures, want):
+            got = fut.result(timeout=120.0)
+            for key in expect:
+                np.testing.assert_allclose(got[key], expect[key],
+                                           atol=1e-6)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if ws._handles[0].dead():
+                break
+            time.sleep(0.05)
+        assert ws._handles[0].dead(), "killed worker must leave dispatch"
+        # dispatch keeps working on the survivor
+        got = ws.infer({feed: xs[0]}, timeout=120.0)
+        for key in want[0]:
+            np.testing.assert_allclose(got[key], want[0][key], atol=1e-6)
+    assert _no_leaked_shm() == []
+
+
+@pytest.mark.slow
+def test_respawn_revives_dead_worker(mlp_bundle):
+    from paddle_tpu.serve.workers import WorkerSet
+
+    feed = mlp_bundle.inputs[0]["name"]
+    x = _pixels(seed=5)
+    with WorkerSet(mlp_bundle, workers=2, model="mnist_mlp",
+                   respawn=True) as ws:
+        ws.wait_ready(timeout=300.0)
+        old_pid = ws._handles[1].process.pid
+        os.kill(old_pid, signal.SIGKILL)
+        deadline = time.monotonic() + 60
+        revived = False
+        while time.monotonic() < deadline:
+            handle = ws._handles[1]
+            if (not handle.dead() and handle.process is not None
+                    and handle.process.pid != old_pid
+                    and handle.ready()):
+                revived = True
+                break
+            time.sleep(0.1)
+        assert revived, "respawn=True must restart the dead slot"
+        got = ws.submit_to(1, {feed: x}).result(timeout=120.0)
+        assert got
+    assert _no_leaked_shm() == []
+
+
+@pytest.mark.slow
+def test_per_worker_steplogs(mlp_bundle, tmp_path, monkeypatch):
+    """Each worker writes its own ``<run>-w<i>.steps.jsonl`` into the
+    telemetry dir; ``summarize_dir`` surfaces the worker index."""
+    from paddle_tpu.observe.steplog import summarize_dir
+    from paddle_tpu.serve.workers import WorkerSet
+
+    tele = tmp_path / "tele"
+    tele.mkdir()
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY", str(tele))
+    feed = mlp_bundle.inputs[0]["name"]
+    with WorkerSet(mlp_bundle, workers=2, model="mnist_mlp") as ws:
+        ws.wait_ready(timeout=300.0)
+        for i in range(4):
+            ws.infer({feed: _pixels(seed=300 + i)}, timeout=120.0)
+    files = sorted(os.path.basename(p)
+                   for p in glob.glob(str(tele / "*.steps.jsonl")))
+    assert files == ["serve-w0.steps.jsonl", "serve-w1.steps.jsonl"]
+    summary = summarize_dir(str(tele))
+    workers = sorted(r.get("serve_worker") for r in summary["runs"])
+    assert workers == [0, 1]
+
+
+@pytest.mark.slow
+def test_cli_serve_workers_sigterm_leaves_no_orphans(mlp_bundle):
+    """SIGTERM of ``cli serve --workers 2`` drains and exits with no
+    orphan worker processes and no leaked shared-memory segments."""
+    tag = "PTPU_WORKERS_LEAK_TEST_%d" % os.getpid()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH="/root/repo", PTPU_TEST_TAG=tag)
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.cli", "serve",
+         mlp_bundle.directory, "--workers", "2", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+    try:
+        banner = ""
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if "serving" in line and "http" in line:
+                banner = line
+                break
+        assert banner, "cli serve --workers never came up"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    # every process that inherited the tag must be gone
+    survivors = []
+    for envf in glob.glob("/proc/[0-9]*/environ"):
+        try:
+            with open(envf, "rb") as fh:
+                if tag.encode() in fh.read():
+                    survivors.append(envf)
+        except OSError:
+            continue  # raced exit
+    assert survivors == [], "orphan worker processes after SIGTERM"
+    leaked = glob.glob("/dev/shm/ptpu-%d-*" % proc.pid)
+    assert leaked == [], "leaked /dev/shm segments after SIGTERM"
+
+
+@pytest.mark.slow
+def test_cli_workers_replicas_mutually_exclusive(mlp_bundle):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.cli", "serve",
+         mlp_bundle.directory, "--workers", "2", "--replicas", "2",
+         "--port", "0"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert proc.returncode == 2
+    assert "--workers" in proc.stderr and "--replicas" in proc.stderr
