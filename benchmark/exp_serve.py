@@ -1179,6 +1179,133 @@ def measure_trace_overhead(args):
     return [row_off, row_on]
 
 
+def measure_health_overhead(args):
+    """The health-plane overhead A/B: identical engines over one
+    bundle, windowed health history + burn-rate SLO monitor ON vs the
+    recorder disabled, driven by the shared closed-loop client loop.
+    Same discipline as the trace-overhead mode: passes are INTERLEAVED
+    so host drift hits both sides equally, each side keeps its best
+    pass whole, and zero post-warmup compiles is a hard gate (the
+    recorder is host-side only by contract — observe/health.py is
+    lint-hot). The on side pays the full production cost: per-request
+    window updates on every submit/retire AND the monitor's periodic
+    fleet evaluation thread running throughout the pass."""
+    from paddle_tpu.observe import health as observe_health
+    from paddle_tpu.observe import steplog as observe_steplog
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine, load_bundle
+
+    bundle_dir = args.bundle or _export_demo_bundle(
+        tempfile.mkdtemp(prefix="serve_health_"),
+        tuple(int(b) for b in args.batch_sizes.split(",")))
+    bundle = load_bundle(bundle_dir)
+    slog_dir = tempfile.mkdtemp(prefix="serve_health_slog_")
+
+    def build(tag):
+        return InferenceEngine(
+            bundle, max_latency_ms=args.max_latency_ms,
+            metrics_registry=MetricsRegistry(), warmup=True,
+            steplog=observe_steplog.StepLog(slog_dir, run_name=tag,
+                                            flush_every=32))
+
+    engine_off, engine_on = build("health_off"), build("health_on")
+    history = observe_health.get_history()
+    monitor = observe_health.SloMonitor(
+        [engine_on], p99_ms=args.health_slo_p99_ms, interval_s=0.2)
+
+    best = {"off": (0.0, float("inf"), float("inf")),
+            "on": (0.0, float("inf"), float("inf"))}
+    requests_before = history.snapshot()["totals"]["requests"]
+    try:
+        with observe_steplog.watch_compiles() as watch:
+            for p in range(args.health_passes):
+                # same seeded payload stream per (side, pass) pair; the
+                # monitor thread runs ONLY during on passes — leaving
+                # it up would slow the off side and flatter the A/B
+                for side, engine, enabled in (
+                        ("off", engine_off, False),
+                        ("on", engine_on, True)):
+                    rng = np.random.RandomState(args.seed + p)
+                    history.set_enabled(enabled)
+                    if enabled:
+                        monitor.start()
+                    lat, wall_s = run_closed_loop(
+                        engine, bundle, args.clients, args.requests,
+                        args.rows_per_request, rng)
+                    if enabled:
+                        monitor.stop()
+                    p50, p99 = _percentiles(lat)
+                    result = (len(lat) / wall_s, p50, p99)
+                    if result[0] > best[side][0]:
+                        best[side] = result
+        history.set_enabled(True)
+        verdict = monitor.evaluate()
+    finally:
+        monitor.stop()
+        history.set_enabled(True)
+        engine_off.stop()
+        engine_on.stop()
+    recorded = (history.snapshot()["totals"]["requests"]
+                - requests_before)
+
+    # gates BEFORE any row emits
+    assert watch.compiles == 0, (
+        "health-overhead gate FAILED: the measured phase minted %d "
+        "compiles (the health recorder must be host-side only): %s"
+        % (watch.compiles, watch.events))
+    assert recorded > 0, (
+        "health-overhead gate FAILED: the on side recorded nothing "
+        "into the health history over %d requests x %d passes"
+        % (args.requests, args.health_passes))
+    assert monitor.evaluations > 0, (
+        "health-overhead gate FAILED: the SLO monitor never evaluated "
+        "during the on passes (interval 0.2s)")
+    qps_off, p50_off, p99_off = best["off"]
+    qps_on, p50_on, p99_on = best["on"]
+    tol = args.health_tol_pct / 100.0
+    assert qps_on >= qps_off * (1.0 - tol), (
+        "health-overhead gate FAILED: health-on qps %.1f more than "
+        "%.1f%% under health-off %.1f"
+        % (qps_on, args.health_tol_pct, qps_off))
+    assert p99_on <= p99_off * (1.0 + tol), (
+        "health-overhead gate FAILED: health-on p99 %.2fms more than "
+        "%.1f%% over health-off %.2fms"
+        % (p99_on, args.health_tol_pct, p99_off))
+
+    base = {
+        "unit": "qps", "requests": args.requests,
+        "clients": args.clients,
+        "rows_per_request": args.rows_per_request, "seed": args.seed,
+        "passes": args.health_passes,
+    }
+    row_off = dict(base, metric="serve_health_off_qps",
+                   value=round(qps_off, 2), p50_ms=p50_off,
+                   p99_ms=p99_off, mode="health_off")
+    row_on = dict(base, metric="serve_health_on_qps",
+                  value=round(qps_on, 2), p50_ms=p50_on, p99_ms=p99_on,
+                  mode="health_on",
+                  slo_p99_ms=args.health_slo_p99_ms,
+                  recorded=int(recorded),
+                  evaluations=int(monitor.evaluations),
+                  overhead_qps_pct=round(
+                      100.0 * (qps_off - qps_on) / qps_off, 2),
+                  overhead_p99_pct=round(
+                      100.0 * (p99_on - p99_off) / p99_off, 2),
+                  gate_tol_pct=args.health_tol_pct,
+                  serve_compiles=watch.compiles)
+    # the SLO verdict itself as a gateable row: burn_rate is a
+    # lower-better unit (observe/regress.py), so a future change that
+    # burns the error budget faster under the same load gates like a
+    # latency regression
+    row_burn = dict(base, unit="burn_rate",
+                    metric="serve_health_fast_burn",
+                    value=verdict["burn_rates"]["fast"],
+                    slo_state=verdict["state"],
+                    slo_p99_ms=args.health_slo_p99_ms,
+                    budget_remaining=verdict["budget_remaining"])
+    return [row_off, row_on, row_burn]
+
+
 def measure_priority(args):
     """The mixed two-model shed run: high-priority MLP at a sustainable
     rate, low-priority MLP flooded, one Router. Only low may shed; the
@@ -1310,7 +1437,8 @@ def main(argv=None):
     ap.add_argument("--mode", default="closed",
                     choices=("closed", "openloop-ab", "priority",
                              "replicas-ab", "workers-ab", "quant-ab",
-                             "sessions", "trace-overhead"))
+                             "sessions", "trace-overhead",
+                             "health-overhead"))
     ap.add_argument("--bundle", default="",
                     help="pre-exported bundle dir (default: export the "
                          "mode's demo bundle to a tmp dir)")
@@ -1425,6 +1553,17 @@ def main(argv=None):
     ap.add_argument("--trace-tol-pct", type=float, default=3.0,
                     help="trace-overhead gate: tracing-on must stay "
                          "within this % of tracing-off qps AND p99")
+    # health-overhead knobs
+    ap.add_argument("--health-passes", type=int, default=3,
+                    help="health-overhead mode: interleaved "
+                         "measurement passes per side, best kept")
+    ap.add_argument("--health-tol-pct", type=float, default=3.0,
+                    help="health-overhead gate: history+SLO on must "
+                         "stay within this % of off qps AND p99")
+    ap.add_argument("--health-slo-p99-ms", type=float, default=50.0,
+                    help="health-overhead mode: the on side's declared "
+                         "p99 objective (the monitor evaluates it on a "
+                         "0.2s cadence during measurement)")
     args = ap.parse_args(argv)
     if args.hardcap_queue is None:
         args.hardcap_queue = 2 * args.decode_slots
@@ -1446,6 +1585,8 @@ def main(argv=None):
         return _emit(measure_sessions(args), "exp_serve_sessions")
     if args.mode == "trace-overhead":
         return _emit(measure_trace_overhead(args), "exp_serve_trace")
+    if args.mode == "health-overhead":
+        return _emit(measure_health_overhead(args), "exp_serve_health")
     bundle_dir = args.bundle
     if not bundle_dir:
         bundle_dir = _export_demo_bundle(

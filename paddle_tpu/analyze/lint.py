@@ -127,10 +127,18 @@ HOT_PATHS = {
     # a host sync here stalls the whole worker fleet
     "serve/workers.py": {"put_frames", "get", "submit", "_submit_to",
                          "_eligible", "_route_session", "_rx_loop",
-                         "_dispatch_response", "queue_depth"},
+                         "_dispatch_response", "queue_depth",
+                         "_op_traces", "_op_history"},
     # request-scoped tracing rides every serving submit/retire: the
     # sampler and the exemplar reservoir must never sync with a device
     "observe/tracing.py": {"resolve", "sample", "offer"},
+    # the windowed health recorder rides the same submit/retire paths
+    # (every request, shed, and dispatch records a window update), and
+    # snapshot runs under the recorder's lock — a host sync in any of
+    # them stalls the serving hot path fleet-wide
+    "observe/health.py": {"record_request", "record_shed",
+                          "record_queue_depth", "record_occupancy",
+                          "snapshot"},
     # the quantized-bundle dequant hook is traced INTO every exported
     # program (serve/export.py), so a stray host sync in it would land
     # on every serving dispatch of every quantized bundle

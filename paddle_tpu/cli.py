@@ -442,6 +442,27 @@ def _make_engine(bundle, args, reg, model=None, warmup="async",
         max_queue_rows=args.max_queue_rows)
 
 
+def _make_slo(fronts, args, model=None):
+    """Burn-rate SLO monitor over the serving fronts
+    (observe/health.py): always built so ``GET /debug/slo`` answers;
+    the periodic evaluation thread (and its ``slo_status`` steplog
+    stream) only starts when an objective was actually declared via
+    ``--slo-p99-ms`` / ``--slo-availability``."""
+    from paddle_tpu.observe import health as observe_health
+    from paddle_tpu.observe import metrics as observe_metrics
+    from paddle_tpu.observe import steplog
+
+    slo = observe_health.SloMonitor(
+        fronts, p99_ms=args.slo_p99_ms,
+        availability=args.slo_availability,
+        registry=observe_metrics.get_registry(),
+        slog=steplog.from_env("slo", meta={"phase": "slo"}),
+        model=model)
+    if slo.active:
+        slo.start()
+    return slo
+
+
 def cmd_serve(args):
     """Serve exported bundles behind the serving tier. Single-model:
     ``cli serve <bundle>`` (the PR 3 surface, plus ``--continuous`` for
@@ -508,10 +529,13 @@ def cmd_serve(args):
                              _make_engine(bundle, args, reg, model=name,
                                           budget_share=budget_share),
                              priority=priority or "normal")
+        slo = _make_slo([router.model(n).engine
+                         for n in router.models()], args)
         server = make_router_server(router, host=args.host,
-                                    port=args.port)
+                                    port=args.port, slo=slo)
         print("serving %s on http://%s:%d (POST /infer/<model>; GET "
-              "/healthz /readyz /metrics /stats /manifest/<model>)"
+              "/healthz /readyz /metrics /stats /debug/slo "
+              "/manifest/<model>)"
               % (sorted(router.models()), *server.server_address))
         try:
             server.serve_forever()
@@ -519,6 +543,7 @@ def cmd_serve(args):
             pass
         finally:
             server.shutdown()
+            slo.stop(close_slog=True)
             router.stop()
         return 0
     if not args.bundle:
@@ -550,9 +575,11 @@ def cmd_serve(args):
             engine.stop()
     from paddle_tpu.serve.server import make_server
 
-    server = make_server(bundle, engine, host=args.host, port=args.port)
+    slo = _make_slo([engine], args, model=bundle.name)
+    server = make_server(bundle, engine, host=args.host, port=args.port,
+                         slo=slo)
     print("serving %r on http://%s:%d (POST /infer; GET /healthz "
-          "/readyz /metrics /stats /manifest)"
+          "/readyz /metrics /stats /debug/slo /manifest)"
           % (bundle.name, *server.server_address))
     try:
         server.serve_forever()
@@ -560,6 +587,7 @@ def cmd_serve(args):
         pass
     finally:
         server.shutdown()
+        slo.stop(close_slog=True)
         engine.stop()
     return 0
 
@@ -689,6 +717,28 @@ def cmd_observe(args):
                   "%d of %d traced): %s"
                   % (tail["q"], tail["threshold_ms"],
                      tail["tail_requests"], tail["requests"], shares))
+    for fleet in summary.get("fleets", ()):
+        # fleet-merged tail attribution across a WorkerSet's per-worker
+        # steplog files: the per-file p99 above is each worker's OWN
+        # tail — this is the fleet's, pooled before the percentile
+        tail = fleet["serve_tail"]
+        shares = "  ".join(
+            "%s %.1f%%" % (k[:-len("_ms")] if k.endswith("_ms") else k,
+                           v)
+            for k, v in sorted(tail["phases"].items(),
+                               key=lambda kv: -kv[1]))
+        print("  fleet %s merged tail attribution (p%g >= %.1f ms, "
+              "%d of %d traced across %d workers): %s"
+              % (fleet["run"], tail["q"], tail["threshold_ms"],
+                 tail["tail_requests"], tail["requests"],
+                 len(fleet["workers"]), shares))
+        breakdown = "  ".join(
+            "w%s p99 %s (%d traced)"
+            % (widx, ("%.1f ms" % w["p99_ms"]) if "p99_ms" in w
+               else "n/a", w["traces"])
+            for widx, w in sorted(fleet["workers"].items(),
+                                  key=lambda kv: int(kv[0])))
+        print("    per-worker: %s" % breakdown)
     if summary["trace_files"]:
         print("  traces (open in https://ui.perfetto.dev): %s"
               % ", ".join(summary["trace_files"]))
@@ -1022,6 +1072,17 @@ def main(argv=None):
     p.add_argument("--max-queue-rows", type=int, default=None,
                    help="bound each hosted queue; a full queue answers "
                         "429 instead of queueing (load shedding)")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="declare a p99 latency objective: the burn-"
+                        "rate SLO monitor evaluates the fleet-merged "
+                        "health history against it (GET /debug/slo, "
+                        "paddle_tpu_slo_* gauges, slo_status steplog "
+                        "records on state transitions)")
+    p.add_argument("--slo-availability", type=float, default=None,
+                   help="availability objective in percent (default "
+                        "99.0 when --slo-p99-ms is set): shed or over-"
+                        "objective requests burn the 1-PCT/100 error "
+                        "budget")
     p.add_argument("--session-store", type=int, default=4096,
                    help="session tier (--continuous): host-store "
                         "capacity in suspended sessions — live "

@@ -433,6 +433,39 @@ def merged_exposition(registry, extras=()):
     return "\n".join(lines) + "\n"
 
 
+def slo_gauges(registry=None):
+    """Register (idempotently) the ``paddle_tpu_slo_*`` gauge family the
+    SLO monitor publishes into: declared p99 objective, current merged
+    p99, fast/slow burn rates, error-budget remaining, and the state
+    enum (-1 no objective declared, 0 ok, 1 burning, 2 breached).
+    Returns the instruments keyed by short name so the monitor sets
+    them without re-registering per verdict."""
+    reg = registry if registry is not None else _global_registry
+    return {
+        "objective_p99_ms": reg.gauge(
+            "paddle_tpu_slo_objective_p99_ms",
+            help="declared p99 latency objective (ms)"),
+        "current_p99_ms": reg.gauge(
+            "paddle_tpu_slo_current_p99_ms",
+            help="fleet-merged p99 latency over the fast window (ms)"),
+        "burn_fast": reg.gauge(
+            "paddle_tpu_slo_burn_rate",
+            help="error-budget burn rate per evaluation window",
+            labels={"window": "fast"}),
+        "burn_slow": reg.gauge(
+            "paddle_tpu_slo_burn_rate",
+            help="error-budget burn rate per evaluation window",
+            labels={"window": "slow"}),
+        "budget_remaining": reg.gauge(
+            "paddle_tpu_slo_budget_remaining",
+            help="fraction of the slow-window error budget left"),
+        "state": reg.gauge(
+            "paddle_tpu_slo_state",
+            help="SLO state (-1 no objective, 0 ok, 1 burning, "
+                 "2 breached)"),
+    }
+
+
 def build_info(registry=None):
     """Register (idempotently) the ``paddle_tpu_build_info`` info-gauge:
     value is always 1, the payload is the label set — ``version``

@@ -41,9 +41,11 @@ GATE_ENV = "PADDLE_TPU_BENCH_GATE"
 # gated (status "ungated"). "bytes" gates footprint rows (a quantized
 # bundle's manifest hbm_estimate_bytes — growing back toward f32 is a
 # regression); "replicas" gates capacity rows (replicas-that-fit under
-# a fixed budget — fewer fitting is a regression).
+# a fixed budget — fewer fitting is a regression); "burn_rate" gates
+# SLO rows (observe/health.py — error budget burning faster is a
+# regression, same as a latency row).
 _LOWER_BETTER_UNITS = ("ms/batch", "ms/step", "ms", "s", "pct_waste",
-                       "bytes")
+                       "bytes", "burn_rate")
 _HIGHER_BETTER_UNITS = ("samples/s", "qps", "MB/s", "checks_passed",
                         "checks", "replicas")
 

@@ -80,6 +80,14 @@ Record types (field ``type``):
   priority-class shed policy): ``model``, ``reason``
   (``queue_full``/``pressure``), optional ``priority`` and ``queued``
   (queue state that triggered the shed).
+* ``slo_status`` — a burn-rate SLO state transition
+  (observe/health.py SloMonitor): ``state``
+  (``ok``/``burning``/``breached``), optional ``prev_state``,
+  ``objective_p99_ms``, ``availability`` (declared objectives),
+  ``current_p99_ms`` (fleet-merged fast-window p99), ``fast_burn``/
+  ``slow_burn`` (error-budget burn rates), ``budget_remaining``,
+  ``breaching_phase`` (tail-attribution's dominant phase),
+  ``worker`` (the worker owning most tail exemplars), ``model``.
 * ``checkpoint`` — one committed training checkpoint
   (distributed/checkpoint.py): ``step`` (global step the snapshot
   captured), ``duration_ms`` (serialize + fsync + atomic rename, on the
@@ -598,6 +606,38 @@ class StepLog:
             rec["queued"] = int(queued)
         self.write(rec)
 
+    def log_slo_status(self, state, prev_state=None,
+                       objective_p99_ms=None, availability=None,
+                       current_p99_ms=None, fast_burn=None,
+                       slow_burn=None, budget_remaining=None,
+                       breaching_phase=None, worker=None, model=None):
+        """One SLO state transition (observe/health.py SloMonitor) —
+        written only when the burn-rate verdict CHANGES state, so the
+        stream stays sparse under steady load."""
+        rec = {"type": "slo_status", "state": str(state),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if prev_state is not None:
+            rec["prev_state"] = str(prev_state)
+        if objective_p99_ms is not None:
+            rec["objective_p99_ms"] = round(float(objective_p99_ms), 4)
+        if availability is not None:
+            rec["availability"] = round(float(availability), 4)
+        if current_p99_ms is not None:
+            rec["current_p99_ms"] = round(float(current_p99_ms), 4)
+        if fast_burn is not None:
+            rec["fast_burn"] = round(float(fast_burn), 4)
+        if slow_burn is not None:
+            rec["slow_burn"] = round(float(slow_burn), 4)
+        if budget_remaining is not None:
+            rec["budget_remaining"] = round(float(budget_remaining), 4)
+        if breaching_phase is not None:
+            rec["breaching_phase"] = str(breaching_phase)
+        if worker is not None:
+            rec["worker"] = str(worker)
+        if model is not None:
+            rec["model"] = str(model)
+        self.write(rec)
+
     def log_checkpoint(self, step, duration_ms, nbytes=None,
                        overlapped=None, step_thread_ms=None, pass_id=None,
                        path=None):
@@ -771,6 +811,7 @@ def summarize_dir(directory):
     import glob
 
     runs = []
+    fleet_traced = {}  # base run name -> {worker index: [serve_trace]}
     for path in sorted(glob.glob(os.path.join(directory, "*.steps.jsonl"))):
         records = read_jsonl(path)
         steps = [r for r in records if r.get("type") == "step"]
@@ -868,6 +909,19 @@ def summarize_dir(directory):
             if tail:
                 run["serve_traces"] = len(traced)
                 run["serve_tail"] = tail
+        if meta.get("worker") is not None and traced:
+            # stash this worker file's traces under the fleet's base
+            # run name (<run>-w<i>): a per-file p99 is blind to the
+            # fleet's true tail, so the report merges across workers
+            # below before attributing
+            import re
+
+            base = str(meta.get("run") or os.path.basename(path))
+            m = re.match(r"^(.*)-w(\d+)$", base)
+            if m:
+                base = m.group(1)
+            fleet_traced.setdefault(base, {})[
+                str(meta.get("worker"))] = traced
         ex = [r["examples_per_sec"] for r in steps
               if "examples_per_sec" in r]
         if not ex:
@@ -880,9 +934,35 @@ def summarize_dir(directory):
             run["cost_first"] = costs[0]
             run["cost_last"] = costs[-1]
         runs.append(run)
+    fleets = []
+    for base in sorted(fleet_traced):
+        # fleet-merged tail attribution: pool every worker file's
+        # serve_trace records for one WorkerSet run, THEN take the p99
+        # — each file in isolation reports its own (wrong) fleet p99
+        from paddle_tpu.observe.metrics import percentile
+        from paddle_tpu.observe.tracing import tail_attribution
+
+        by_worker = fleet_traced[base]
+        merged = [r for recs in by_worker.values() for r in recs]
+        tail = tail_attribution(merged)
+        if not tail:
+            continue
+        entry = {"run": base, "serve_traces": len(merged),
+                 "serve_tail": tail, "workers": {}}
+        for widx in sorted(by_worker, key=int):
+            recs = by_worker[widx]
+            lats = [r["latency_ms"] for r in recs if "latency_ms" in r]
+            w = {"traces": len(recs)}
+            if lats:
+                w["p99_ms"] = round(percentile(lats, 99), 3)
+            entry["workers"][widx] = w
+        fleets.append(entry)
     traces = sorted(
         os.path.basename(p)
         for pat in ("*.json", "*.json.gz")
         for p in glob.glob(os.path.join(directory, pat))
         if not p.endswith(".steps.jsonl"))
-    return {"directory": directory, "runs": runs, "trace_files": traces}
+    out = {"directory": directory, "runs": runs, "trace_files": traces}
+    if fleets:
+        out["fleets"] = fleets
+    return out

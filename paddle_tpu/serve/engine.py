@@ -34,6 +34,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from paddle_tpu.observe import health as observe_health
 from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.observe import spans as observe_spans
 from paddle_tpu.observe import steplog as observe_steplog
@@ -274,6 +275,7 @@ class InferenceEngine:
                     and self._queued_rows + rows > self.max_queue_rows):
                 self._stats["shed"] += 1
                 self._m_shed.inc()
+                observe_health.get_history().record_shed("queue_full")
                 raise Overloaded(
                     "queue full: %d rows queued + %d requested > "
                     "max_queue_rows=%d — shed, retry against a less "
@@ -292,6 +294,8 @@ class InferenceEngine:
             self._queued_rows += rows
             self._in_flight += 1
             self._m_queue_depth.set(self._queued_rows)
+            observe_health.get_history().record_queue_depth(
+                self._queued_rows)
             self._m_in_flight.set(self._in_flight)
             self._cv.notify_all()
         return req.future
@@ -460,6 +464,8 @@ class InferenceEngine:
                                 model=self.model, replica=self.replica,
                                 trace_id=(req.trace.trace_id
                                           if req.trace else None))
+                observe_health.get_history().record_request(
+                    latency_ms, phases)
                 if req.trace is not None:
                     self._emit_trace(req, phases, trace_total_ms,
                                      t_start, t_form, t_done, t_ser)

@@ -47,6 +47,7 @@ first dispatch.
 import collections
 import threading
 
+from paddle_tpu.observe import health as observe_health
 from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.observe import steplog as observe_steplog
 from paddle_tpu.serve.engine import InferenceEngine, Overloaded
@@ -275,6 +276,7 @@ class ReplicaSet:
         eligible = self._eligible()
         if not eligible:
             self._m_shed.inc()
+            observe_health.get_history().record_shed("no_replica")
             raise Overloaded(
                 "no warm live replica (fleet of %d still warming or "
                 "failed) — retry after /readyz goes green"

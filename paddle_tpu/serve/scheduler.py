@@ -62,6 +62,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from paddle_tpu.observe import health as observe_health
 from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.observe import spans as observe_spans
 from paddle_tpu.observe import steplog as observe_steplog
@@ -399,6 +400,7 @@ class ContinuousScheduler:
                     and len(self._queue) >= self.max_queue):
                 self._stats["shed"] += 1
                 self._m_shed.inc()
+                observe_health.get_history().record_shed("queue_full")
                 raise Overloaded(
                     "decode queue full: %d requests queued >= "
                     "max_queue=%d" % (len(self._queue), self.max_queue),
@@ -416,6 +418,8 @@ class ContinuousScheduler:
             self._queue.append(req)
             self._in_flight += 1
             self._m_queue_depth.set(len(self._queue))
+            observe_health.get_history().record_queue_depth(
+                len(self._queue))
             self._m_in_flight.set(self._in_flight)
             self._cv.notify_all()
         return req.future
@@ -1129,6 +1133,8 @@ class ContinuousScheduler:
                 self._m_retired.inc(len(retired))
             self._m_iter_ms.observe(infer_ms)
             self._m_occupancy.set(active / self.slots)
+            observe_health.get_history().record_occupancy(
+                active / self.slots)
             if self._slog is not None:
                 self._slog.log_serve_decode(
                     iteration=self._iter_counter, active=active,
@@ -1325,6 +1331,8 @@ class ContinuousScheduler:
                                 session=req.session,
                                 trace_id=(req.trace.trace_id
                                           if req.trace else None))
+                observe_health.get_history().record_request(
+                    latency_ms, phases)
                 if req.trace is not None:
                     self._emit_trace(req, phases, trace_total_ms,
                                      t_done, t_ser)

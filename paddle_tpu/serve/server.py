@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from paddle_tpu.observe import health as observe_health
 from paddle_tpu.observe import spans as observe_spans
 from paddle_tpu.observe import tracing as observe_tracing
 from paddle_tpu.serve.bundle import SEQ_KINDS, flat_keys
@@ -181,6 +182,7 @@ class _Handler(_BaseHandler):
 
     engine = None
     bundle = None
+    slo = None
 
     def do_GET(self):
         if self.path == "/healthz":
@@ -200,9 +202,12 @@ class _Handler(_BaseHandler):
             self._send(200, self.engine.stats())
         elif self.path == "/debug/traces":
             # the always-on tail surface: sampling state + the
-            # slowest-N per-request phase breakdowns (works at sample
+            # slowest-N per-request phase breakdowns, merged fleet-
+            # wide when the engine is worker-backed (works at sample
             # rate 0 — exemplars are collected for every request)
-            self._send(200, observe_tracing.debug_traces())
+            self._send(200, observe_health.collect_traces([self.engine]))
+        elif self.path == "/debug/slo":
+            self._send(200, self.slo.evaluate())
         elif self.path == "/manifest":
             self._send(200, self.bundle.manifest)
         else:
@@ -234,6 +239,7 @@ class _RouterHandler(_BaseHandler):
     """Multi-model handler over a Router."""
 
     router = None
+    slo = None
 
     def do_GET(self):
         router = self.router
@@ -262,7 +268,10 @@ class _RouterHandler(_BaseHandler):
         elif self.path == "/stats":
             self._send(200, router.stats())
         elif self.path == "/debug/traces":
-            self._send(200, observe_tracing.debug_traces())
+            self._send(200, observe_health.collect_traces(
+                self._fronts()))
+        elif self.path == "/debug/slo":
+            self._send(200, self.slo.evaluate())
         elif self.path == "/manifest":
             try:
                 self._send(200, router.default_model().bundle.manifest)
@@ -306,34 +315,46 @@ class _RouterHandler(_BaseHandler):
                                   session_id=session_id,
                                   end_session=end_session, trace=trace))
 
+    def _fronts(self):
+        return [self.router.model(name).engine
+                for name in self.router.models()]
 
-def make_server(bundle, engine, host="127.0.0.1", port=0):
+
+def make_server(bundle, engine, host="127.0.0.1", port=0, slo=None):
     """Single-model server bound to (host, port); ``port=0`` picks a
-    free port (``server.server_address[1]`` is the actual one)."""
+    free port (``server.server_address[1]`` is the actual one).
+    ``slo=`` is an :class:`~paddle_tpu.observe.health.SloMonitor`; when
+    omitted a no-objective monitor is built so ``GET /debug/slo``
+    always answers (state ``no_objective``, burn rates zero)."""
+    if slo is None:
+        slo = observe_health.SloMonitor([engine])
     handler = type("BundleHandler", (_Handler,),
-                   {"engine": engine, "bundle": bundle})
+                   {"engine": engine, "bundle": bundle, "slo": slo})
     return ThreadingHTTPServer((host, port), handler)
 
 
-def make_router_server(router, host="127.0.0.1", port=0):
+def make_router_server(router, host="127.0.0.1", port=0, slo=None):
     """Multi-model server over a :class:`~paddle_tpu.serve.router
     .Router` (POST /infer/<model>, per-model /readyz, 429 shedding)."""
+    if slo is None:
+        slo = observe_health.SloMonitor(
+            [router.model(name).engine for name in router.models()])
     handler = type("RouterHandler", (_RouterHandler,),
-                   {"router": router})
+                   {"router": router, "slo": slo})
     return ThreadingHTTPServer((host, port), handler)
 
 
-def serve_in_thread(bundle, engine, host="127.0.0.1", port=0):
+def serve_in_thread(bundle, engine, host="127.0.0.1", port=0, slo=None):
     """Start a single-model server on a daemon thread; returns
     (server, thread) — tests and notebooks use this, the CLI uses
     serve_forever."""
-    return _spawn(make_server(bundle, engine, host, port))
+    return _spawn(make_server(bundle, engine, host, port, slo=slo))
 
 
-def serve_router_in_thread(router, host="127.0.0.1", port=0):
+def serve_router_in_thread(router, host="127.0.0.1", port=0, slo=None):
     """Start a multi-model router server on a daemon thread; returns
     (server, thread)."""
-    return _spawn(make_router_server(router, host, port))
+    return _spawn(make_router_server(router, host, port, slo=slo))
 
 
 def _spawn(server):

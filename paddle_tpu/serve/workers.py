@@ -61,6 +61,7 @@ from multiprocessing import shared_memory
 
 import numpy as np
 
+from paddle_tpu.observe import health as observe_health
 from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.serve.engine import Overloaded
 from paddle_tpu.serve.sessions import (ConsistentHashRing, SessionGone,
@@ -356,6 +357,26 @@ def _raise_error(header):
 
 # -- the worker process ------------------------------------------------------
 
+def _op_traces():
+    """``traces`` control verb: this worker's exemplar reservoir +
+    trace counters, slowest-first — the router merges the dumps fleet-
+    wide (observe.health.collect_traces) with ``worker=`` provenance.
+    Pure host dict copies; nothing on this path may touch a device
+    value (it runs on the control thread but is lint-hot by contract)."""
+    from paddle_tpu.observe import tracing
+
+    return {"ok": True, "traces": tracing.debug_traces()}
+
+
+def _op_history():
+    """``history`` control verb: this worker's windowed health-history
+    snapshot (torn-read free by HealthHistory's lock), merged at the
+    router by epoch (observe.health.collect_history)."""
+    from paddle_tpu.observe import health
+
+    return {"ok": True, "history": health.get_history().snapshot()}
+
+
 def _worker_main(index, bundle_dir, continuous, engine_kwargs, model,
                  run_name, conn, ring_spec, warmup):
     """Entry point of one worker process (``spawn``): load the bundle,
@@ -535,6 +556,10 @@ def _worker_main(index, bundle_dir, continuous, engine_kwargs, model,
                 elif op == "metrics":
                     rpc.send({"ok": True,
                               "families": engine.metrics.dump_series()})
+                elif op == "traces":
+                    rpc.send(_op_traces())
+                elif op == "history":
+                    rpc.send(_op_history())
                 elif op == "compiles":
                     rpc.send({"ok": True,
                               "compiles": watcher.compiles})
@@ -1064,6 +1089,7 @@ class WorkerSet:
         eligible = self._eligible()
         if not eligible:
             self._m_shed.inc()
+            observe_health.get_history().record_shed("no_replica")
             raise Overloaded(
                 "no warm live worker (fleet of %d still warming or "
                 "failed) — retry after /readyz goes green"

@@ -155,6 +155,15 @@ def _golden_registry(include_workers=True):
                        buckets=(0.5, 2.0, 10.0))
     for v in (0.2, 1.1, 6.0):
         sw.observe(v)
+    # the SLO verdict gauges (observe/health.py SloMonitor publishes
+    # into these every evaluation) — fixed mid-burn values
+    slo = metrics.slo_gauges(reg)
+    slo["objective_p99_ms"].set(50)
+    slo["current_p99_ms"].set(42.5)
+    slo["burn_fast"].set(0.62)
+    slo["burn_slow"].set(0.4)
+    slo["budget_remaining"].set(0.6)
+    slo["state"].set(0)
     # the build-info info-gauge (value is always 1, the payload is the
     # label set) — fixed label values here; live engines stamp the real
     # versions through observe.metrics.build_info()

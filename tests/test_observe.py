@@ -434,6 +434,12 @@ def _full_featured_log(tmp_path):
         slog.log_serve_batch(rows=3, bucket=4, infer_ms=1.2, batch_id=1,
                              pad_rows=1, requests=2, queue_ms_max=0.7,
                              flush="deadline")
+        slog.log_slo_status(state="burning", prev_state="ok",
+                            objective_p99_ms=50.0, availability=99.0,
+                            current_p99_ms=61.2, fast_burn=1.4,
+                            slow_burn=0.7, budget_remaining=0.3,
+                            breaching_phase="queue_ms", worker="1",
+                            model="mnist_mlp")
         slog.log_pass(0, metrics={"err": 0.25})
     return steplog.read_jsonl(os.path.join(str(tmp_path),
                                            "unit.steps.jsonl"))
