@@ -84,6 +84,16 @@ audited set via ``observe/regress.py`` (warn-only by default,
   session, the cap bites on the baseline, and the mean spill
   device_get stays under the mean window dispatch (the overlap claim).
 
+* ``--mode slo-ab`` — the self-tuning acceptance A/B (docs/control.md):
+  one shifting open-loop trace against a hand-tuned engine and an
+  identical engine started with a deliberately WRONG batch deadline,
+  the SLO controller closing the loop over its knob registry. Gates
+  before any row emits: the controller moved the deadline knob, the
+  converged side lands within ``--slo-tol-pct`` (default 10%) of the
+  hand-tuned qps AND p99, zero post-warmup compiles (knobs are
+  host-side by contract), and every move is present as an additive
+  ``control_action`` steplog record.
+
 Usage:
   python benchmark/exp_serve.py                       # closed-loop MLP
   python benchmark/exp_serve.py --mode openloop-ab
@@ -1306,6 +1316,192 @@ def measure_health_overhead(args):
     return [row_off, row_on, row_burn]
 
 
+def measure_slo_ab(args):
+    """The self-tuning acceptance A/B (docs/control.md): ONE shifting
+    open-loop trace (three Poisson segments at 1.0x/1.6x/0.7x the base
+    rate — the load the controller must keep up with) against (a) a
+    hand-tuned engine and (b) an identical engine started with a
+    deliberately WRONG batch deadline, with the SLO controller closing
+    the loop over its knob registry. The wrong deadline holds every
+    request open far past the objective, the tail attribution lands on
+    ``queue_ms`` (the whole-request engine bills its deadline hold
+    there), and the controller's queue family walks down to its only
+    registered lever: ``engine.batch_deadline_ms``.
+
+    Gates asserted BEFORE any row emits: the controller actually moved
+    the knob (>= 3 moves, ending below the wrong start), the converged
+    side lands within ``--slo-tol-pct`` of hand-tuned sustained qps AND
+    p99, the whole run (convergence included) mints ZERO post-warmup
+    compiles (every knob is host-side by contract — jit shapes are not
+    knobs), and every move the controller counted is present as an
+    additive ``control_action`` steplog record (the audit trail
+    ``cli observe`` prints as the knob-move timeline)."""
+    from paddle_tpu.control import Controller, KnobRegistry
+    from paddle_tpu.observe import health as observe_health
+    from paddle_tpu.observe import steplog as observe_steplog
+    from paddle_tpu.observe import tracing as observe_tracing
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import InferenceEngine, load_bundle
+
+    bundle_dir = args.bundle or _export_demo_bundle(
+        tempfile.mkdtemp(prefix="serve_slo_"),
+        tuple(int(b) for b in args.batch_sizes.split(",")))
+    bundle = load_bundle(bundle_dir)
+    spec = bundle.inputs[0]
+    shape = (1,) + tuple(bundle.feed_shape(spec, 1)[1:])
+    rng = np.random.RandomState(args.seed)
+    payloads = [{spec["name"]: rng.randn(*shape).astype(spec["dtype"])}
+                for _ in range(8)]
+    # the shifting schedule: both sides replay the IDENTICAL offsets
+    seg_n = max(args.requests // 3, 1)
+    segments, t0 = [], 0.0
+    for mult in (1.0, 1.6, 0.7):
+        offs = t0 + np.cumsum(rng.exponential(
+            1.0 / (args.slo_qps * mult), size=seg_n))
+        segments.append(offs)
+        t0 = float(offs[-1])
+    arrivals = np.concatenate(segments)
+
+    slog_dir = tempfile.mkdtemp(prefix="serve_slo_slog_")
+    reg_tuned = MetricsRegistry()
+
+    def build(tag, deadline_ms, reg):
+        return InferenceEngine(
+            bundle, max_latency_ms=deadline_ms, metrics_registry=reg,
+            warmup=True,
+            steplog=observe_steplog.StepLog(slog_dir, run_name=tag,
+                                            flush_every=32))
+
+    engine_hand = build("slo_hand", args.slo_hand_latency_ms,
+                        MetricsRegistry())
+    engine_tuned = build("slo_tuned", args.slo_wrong_latency_ms,
+                         reg_tuned)
+    history = observe_health.get_history()
+    exemplars = observe_tracing.get_exemplars()
+
+    def replay(engine):
+        lat, _, _, done = drive_open_loop(
+            lambda i: engine.submit(payloads[i % len(payloads)]),
+            arrivals)
+        return lat, done
+
+    controller = None
+    ctl_slog = None
+    history.set_enabled(True)
+    try:
+        with observe_steplog.watch_compiles() as watch:
+            # hand-tuned baseline first: its measured p99 IS the
+            # objective the controller must reach (auto mode)
+            history.reset()
+            exemplars.reset()
+            lat_hand, done_hand = replay(engine_hand)
+            p50_hand, p99_hand = _percentiles(lat_hand)
+            qps_hand = sustained_qps(done_hand)
+            objective = args.slo_ab_p99_ms or round(0.8 * p99_hand, 3)
+
+            knobs = KnobRegistry()
+            engine_tuned.register_knobs(knobs)
+            monitor = observe_health.SloMonitor(
+                [engine_tuned], p99_ms=objective, fast_s=2.0,
+                slow_s=30.0, interval_s=0.2)
+            ctl_slog = observe_steplog.StepLog(
+                slog_dir, run_name="slo_control", flush_every=1)
+            controller = Controller(
+                monitor, knobs, interval_s=0.15,
+                cooldown_s=args.slo_cooldown_s, hysteresis=2,
+                slog=ctl_slog, registry=reg_tuned, model="slo_tuned")
+
+            # convergence: replay the shifting trace with the control
+            # loop live until the monitor reads ok (or rounds run out —
+            # the measured A/B below is the acceptance, not the state)
+            history.reset()
+            exemplars.reset()
+            controller.start()
+            rounds, verdict = 0, None
+            for rounds in range(1, args.slo_rounds + 1):
+                replay(engine_tuned)
+                verdict = monitor.evaluate()
+                if verdict["state"] == "ok":
+                    break
+            controller.stop()
+            convergence_steps = controller.moves
+            deadline_knob = knobs.get("engine.batch_deadline_ms")
+            converged_ms = deadline_knob.value
+
+            # measurement: knobs frozen at the converged values, same
+            # trace again — the side-by-side the gates compare
+            history.reset()
+            lat_tuned, done_tuned = replay(engine_tuned)
+            final_verdict = monitor.evaluate()
+    finally:
+        if controller is not None:
+            controller.stop()
+        if ctl_slog is not None:
+            ctl_slog.close()
+        engine_hand.stop()
+        engine_tuned.stop()
+    p50_tuned, p99_tuned = _percentiles(lat_tuned)
+    qps_tuned = sustained_qps(done_tuned)
+    actions = [r for r in observe_steplog.read_jsonl(ctl_slog.path)
+               if r.get("type") == "control_action"]
+
+    # gates BEFORE any row emits
+    assert watch.compiles == 0, (
+        "slo-ab gate FAILED: the control loop minted %d compiles "
+        "(knobs must be host-side only — jit shapes are not knobs): %s"
+        % (watch.compiles, watch.events))
+    assert controller.moves >= 3 and converged_ms < \
+        args.slo_wrong_latency_ms, (
+        "slo-ab gate FAILED: controller made %d move(s) and left the "
+        "deadline at %.2fms (started wrong at %.2fms) — the loop "
+        "never closed" % (controller.moves, converged_ms,
+                          args.slo_wrong_latency_ms))
+    assert len(actions) == controller.moves + controller.rollbacks, (
+        "slo-ab gate FAILED: %d control_action records for %d moves + "
+        "%d rollbacks — the audit trail lost moves"
+        % (len(actions), controller.moves, controller.rollbacks))
+    tol = args.slo_tol_pct / 100.0
+    assert qps_tuned >= qps_hand * (1.0 - tol), (
+        "slo-ab gate FAILED: converged qps %.1f more than %.0f%% "
+        "under hand-tuned %.1f" % (qps_tuned, args.slo_tol_pct,
+                                   qps_hand))
+    assert p99_tuned <= p99_hand * (1.0 + tol), (
+        "slo-ab gate FAILED: converged p99 %.2fms more than %.0f%% "
+        "over hand-tuned %.2fms" % (p99_tuned, args.slo_tol_pct,
+                                    p99_hand))
+
+    base = {
+        "unit": "qps", "requests": len(arrivals),
+        "offered_qps": args.slo_qps, "seed": args.seed,
+        "arrivals": "poisson_shifting_1.0_1.6_0.7",
+        "slo_p99_ms": objective,
+    }
+    row_hand = dict(base, metric="serve_slo_hand_qps",
+                    value=round(qps_hand, 2), p50_ms=p50_hand,
+                    p99_ms=p99_hand, mode="hand_tuned",
+                    max_latency_ms=args.slo_hand_latency_ms)
+    row_tuned = dict(base, metric="serve_slo_tuned_qps",
+                     value=round(qps_tuned, 2), p50_ms=p50_tuned,
+                     p99_ms=p99_tuned, mode="autotuned",
+                     start_latency_ms=args.slo_wrong_latency_ms,
+                     converged_latency_ms=round(converged_ms, 3),
+                     moves=int(controller.moves),
+                     rollbacks=int(controller.rollbacks),
+                     rounds=int(rounds),
+                     slo_state=final_verdict["state"],
+                     gate_tol_pct=args.slo_tol_pct,
+                     serve_compiles=watch.compiles)
+    # convergence cost as an audited lower-better row: a controller
+    # change that needs more moves to reach the same objective gates
+    # like a latency regression (observe/regress.py)
+    row_conv = dict(base, unit="convergence_steps",
+                    metric="serve_slo_convergence_steps",
+                    value=int(convergence_steps),
+                    rounds=int(rounds),
+                    converged_latency_ms=round(converged_ms, 3))
+    return [row_hand, row_tuned, row_conv]
+
+
 def measure_priority(args):
     """The mixed two-model shed run: high-priority MLP at a sustainable
     rate, low-priority MLP flooded, one Router. Only low may shed; the
@@ -1438,7 +1634,7 @@ def main(argv=None):
                     choices=("closed", "openloop-ab", "priority",
                              "replicas-ab", "workers-ab", "quant-ab",
                              "sessions", "trace-overhead",
-                             "health-overhead"))
+                             "health-overhead", "slo-ab"))
     ap.add_argument("--bundle", default="",
                     help="pre-exported bundle dir (default: export the "
                          "mode's demo bundle to a tmp dir)")
@@ -1564,6 +1760,35 @@ def main(argv=None):
                     help="health-overhead mode: the on side's declared "
                          "p99 objective (the monitor evaluates it on a "
                          "0.2s cadence during measurement)")
+    # slo-ab knobs (--mode slo-ab)
+    ap.add_argument("--slo-ab-p99-ms", type=float, default=0.0,
+                    help="slo-ab mode: the declared p99 objective the "
+                         "controller converges toward (0 = auto: 0.8 x "
+                         "the hand-tuned side's measured p99, so the "
+                         "controller must at least match the hand "
+                         "tuning)")
+    ap.add_argument("--slo-hand-latency-ms", type=float, default=2.0,
+                    help="slo-ab mode: the hand-tuned side's batch "
+                         "deadline (the baseline the converged side "
+                         "must match)")
+    ap.add_argument("--slo-wrong-latency-ms", type=float, default=60.0,
+                    help="slo-ab mode: the autotuned side's deliberately "
+                         "WRONG starting batch deadline (holds every "
+                         "request far past the objective)")
+    ap.add_argument("--slo-qps", type=float, default=300.0,
+                    help="slo-ab mode: base offered rate of the "
+                         "shifting trace (segments run at 1.0x/1.6x/"
+                         "0.7x this rate)")
+    ap.add_argument("--slo-rounds", type=int, default=12,
+                    help="slo-ab mode: max convergence replays of the "
+                         "trace before measurement (the loop breaks "
+                         "early once the monitor reads ok)")
+    ap.add_argument("--slo-cooldown-s", type=float, default=0.5,
+                    help="slo-ab mode: controller per-knob cooldown "
+                         "(short — the bench's fast window is 2s)")
+    ap.add_argument("--slo-tol-pct", type=float, default=10.0,
+                    help="slo-ab gate: converged side must land within "
+                         "this %% of hand-tuned sustained qps AND p99")
     args = ap.parse_args(argv)
     if args.hardcap_queue is None:
         args.hardcap_queue = 2 * args.decode_slots
@@ -1587,6 +1812,8 @@ def main(argv=None):
         return _emit(measure_trace_overhead(args), "exp_serve_trace")
     if args.mode == "health-overhead":
         return _emit(measure_health_overhead(args), "exp_serve_health")
+    if args.mode == "slo-ab":
+        return _emit(measure_slo_ab(args), "exp_serve_slo")
     bundle_dir = args.bundle
     if not bundle_dir:
         bundle_dir = _export_demo_bundle(

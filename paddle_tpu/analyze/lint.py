@@ -154,6 +154,12 @@ HOT_PATHS = {
     # whole train loop and the mesh strategy's per-step wrappers
     "distributed/worker.py": {"main"},
     "parallel/mesh.py": {"run", "shard_batch"},
+    # the SLO controller's decide/apply cycle runs on the control
+    # cadence but its knob apply hooks take the engines' hot-path
+    # locks — a host sync while holding one stalls serving exactly
+    # when the loop is trying to rescue it
+    "control/controller.py": {"step", "_judge_pending_locked",
+                              "_decide_locked"},
 }
 
 # Calls whose results are device-resident values: reading them back with

@@ -463,6 +463,44 @@ def _make_slo(fronts, args, model=None):
     return slo
 
 
+def _make_controller(slo, fronts, args, model=None):
+    """The actuation half of the SLO loop (docs/control.md): with
+    ``--autotune``, collect every knob the serving fronts register —
+    the router's shed ceilings, the fleet/worker-set width and its
+    members' broadcast knobs, a single engine's deadline and queue
+    bound — and start the named controller thread over them. Needs a
+    declared objective: a controller with nothing to steer toward
+    would never act, so silently 'enabling' it would be a lie."""
+    if not getattr(args, "autotune", False):
+        return None
+    if not slo.active:
+        print("--autotune needs a declared objective: add --slo-p99-ms "
+              "(and optionally --slo-availability)", file=sys.stderr)
+        raise SystemExit(2)
+    from paddle_tpu.control import Controller, KnobRegistry
+    from paddle_tpu.observe import metrics as observe_metrics
+    from paddle_tpu.observe import steplog
+
+    knobs = KnobRegistry()
+    for front in fronts:
+        if not hasattr(front, "register_knobs"):
+            continue
+        try:
+            front.register_knobs(knobs)
+        except ValueError:
+            # multi-model routers host N engines that would all claim
+            # engine.*: the first registrant keeps the name, later
+            # models stay hand-tuned (name a dedicated deployment to
+            # autotune a specific model)
+            pass
+    controller = Controller(
+        slo, knobs, registry=observe_metrics.get_registry(),
+        slog=steplog.from_env("control", meta={"phase": "control"}),
+        model=model)
+    controller.start()
+    return controller
+
+
 def cmd_serve(args):
     """Serve exported bundles behind the serving tier. Single-model:
     ``cli serve <bundle>`` (the PR 3 surface, plus ``--continuous`` for
@@ -531,18 +569,25 @@ def cmd_serve(args):
                              priority=priority or "normal")
         slo = _make_slo([router.model(n).engine
                          for n in router.models()], args)
+        controller = _make_controller(
+            slo, [router] + [router.model(n).engine
+                             for n in router.models()], args)
         server = make_router_server(router, host=args.host,
-                                    port=args.port, slo=slo)
+                                    port=args.port, slo=slo,
+                                    controller=controller)
         print("serving %s on http://%s:%d (POST /infer/<model>; GET "
-              "/healthz /readyz /metrics /stats /debug/slo "
+              "/healthz /readyz /metrics /stats /debug/slo%s "
               "/manifest/<model>)"
-              % (sorted(router.models()), *server.server_address))
+              % (sorted(router.models()), *server.server_address,
+                 " /debug/control" if controller else ""))
         try:
             server.serve_forever()
         except KeyboardInterrupt:
             pass
         finally:
             server.shutdown()
+            if controller is not None:
+                controller.stop()
             slo.stop(close_slog=True)
             router.stop()
         return 0
@@ -576,17 +621,21 @@ def cmd_serve(args):
     from paddle_tpu.serve.server import make_server
 
     slo = _make_slo([engine], args, model=bundle.name)
+    controller = _make_controller(slo, [engine], args, model=bundle.name)
     server = make_server(bundle, engine, host=args.host, port=args.port,
-                         slo=slo)
+                         slo=slo, controller=controller)
     print("serving %r on http://%s:%d (POST /infer; GET /healthz "
-          "/readyz /metrics /stats /debug/slo /manifest)"
-          % (bundle.name, *server.server_address))
+          "/readyz /metrics /stats /debug/slo%s /manifest)"
+          % (bundle.name, *server.server_address,
+             " /debug/control" if controller else ""))
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
         server.shutdown()
+        if controller is not None:
+            controller.stop()
         slo.stop(close_slog=True)
         engine.stop()
     return 0
@@ -717,6 +766,21 @@ def cmd_observe(args):
                   "%d of %d traced): %s"
                   % (tail["q"], tail["threshold_ms"],
                      tail["tail_requests"], tail["requests"], shares))
+        if "control_actions" in run:
+            # the knob-move timeline, next to the tail attribution the
+            # moves were reacting to: what the controller did, in
+            # order, with the burn it was fighting
+            moves = run["control_actions"]
+            print("    control timeline: %d knob move(s), %d rollback(s)"
+                  % (len(moves), run.get("control_rollbacks", 0)))
+            for a in moves:
+                burn = ("  burn %.2f" % a["burn_rate_before"]
+                        if "burn_rate_before" in a else "")
+                phase = (" [%s]" % a["breaching_phase"]
+                         if "breaching_phase" in a else "")
+                print("      t=%-8.2f %-24s %g -> %g  %s%s%s"
+                      % (a.get("t", 0.0), a["knob"], a["old"], a["new"],
+                         a["reason"], phase, burn))
     for fleet in summary.get("fleets", ()):
         # fleet-merged tail attribution across a WorkerSet's per-worker
         # steplog files: the per-file p99 above is each worker's OWN
@@ -1083,6 +1147,16 @@ def main(argv=None):
                         "99.0 when --slo-p99-ms is set): shed or over-"
                         "objective requests burn the 1-PCT/100 error "
                         "budget")
+    p.add_argument("--autotune", action="store_true",
+                   help="close the SLO loop (docs/control.md): a named "
+                        "controller thread maps breaching-phase burn-"
+                        "rate verdicts onto the registered serving "
+                        "knobs (deadlines, queue/shed ceilings, spill "
+                        "thresholds, fleet width) with hysteresis, "
+                        "cooldowns, and a rollback guard; every move "
+                        "is a control_action steplog record, a paddle_"
+                        "tpu_control_* metric, and a GET /debug/"
+                        "control entry. Needs --slo-p99-ms")
     p.add_argument("--session-store", type=int, default=4096,
                    help="session tier (--continuous): host-store "
                         "capacity in suspended sessions — live "

@@ -1,0 +1,15 @@
+"""Feedback control for the serving tier (docs/control.md).
+
+``observe/`` is the sensing half of the SLO loop — windowed health
+history, burn-rate verdicts, tail attribution. This package is the
+ACTUATION half: a declarative registry of live-adjustable serving
+parameters (:mod:`paddle_tpu.control.knobs`) and a controller thread
+that moves them in response to burn-rate verdicts
+(:mod:`paddle_tpu.control.controller`), with hysteresis, per-knob
+cooldowns, bounded step sizes, and a rollback guard.
+"""
+
+from paddle_tpu.control.knobs import Knob, KnobRegistry
+from paddle_tpu.control.controller import Controller
+
+__all__ = ["Knob", "KnobRegistry", "Controller"]

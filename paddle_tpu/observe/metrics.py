@@ -466,6 +466,32 @@ def slo_gauges(registry=None):
     }
 
 
+def control_instruments(registry=None, knob=""):
+    """Register (idempotently) the ``paddle_tpu_control_*`` families the
+    SLO controller (control/controller.py) mirrors its knob moves onto:
+    a per-knob action counter, a per-knob gauge holding the value the
+    last move installed, and a rollback counter — the thrash alarm (a
+    rising rollback rate means the controller is fighting its own
+    moves). Returns the instruments keyed by short name, bound to the
+    given ``knob`` label."""
+    reg = registry if registry is not None else _global_registry
+    labels = {"knob": str(knob)} if knob else None
+    return {
+        "actions": reg.counter(
+            "paddle_tpu_control_actions_total",
+            help="knob moves applied by the SLO controller",
+            labels=labels),
+        "knob_value": reg.gauge(
+            "paddle_tpu_control_knob",
+            help="knob value installed by the last controller move",
+            labels=labels),
+        "rollbacks": reg.counter(
+            "paddle_tpu_control_rollbacks_total",
+            help="controller moves reverted by the rollback guard",
+            labels=labels),
+    }
+
+
 def build_info(registry=None):
     """Register (idempotently) the ``paddle_tpu_build_info`` info-gauge:
     value is always 1, the payload is the label set — ``version``

@@ -43,9 +43,11 @@ GATE_ENV = "PADDLE_TPU_BENCH_GATE"
 # regression); "replicas" gates capacity rows (replicas-that-fit under
 # a fixed budget — fewer fitting is a regression); "burn_rate" gates
 # SLO rows (observe/health.py — error budget burning faster is a
-# regression, same as a latency row).
+# regression, same as a latency row). "convergence_steps" gates the
+# slo-ab controller rows (control/controller.py — more knob moves to
+# reach the hand-tuned envelope means a slower control loop).
 _LOWER_BETTER_UNITS = ("ms/batch", "ms/step", "ms", "s", "pct_waste",
-                       "bytes", "burn_rate")
+                       "bytes", "burn_rate", "convergence_steps")
 _HIGHER_BETTER_UNITS = ("samples/s", "qps", "MB/s", "checks_passed",
                         "checks", "replicas")
 

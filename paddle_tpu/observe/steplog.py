@@ -638,6 +638,30 @@ class StepLog:
             rec["model"] = str(model)
         self.write(rec)
 
+    def log_control_action(self, knob, old, new, reason,
+                           breaching_phase=None, burn_rate_before=None,
+                           rollback=None, model=None):
+        """One knob move applied by the SLO controller
+        (control/controller.py) — including reverts, which carry
+        ``rollback: true``. ``reason`` is the play that fired
+        (``shed_earlier``, ``spill_later``, ``tighten_deadline``,
+        ``rollback``, ...); ``burn_rate_before`` is the fast burn the
+        move was reacting to, so ``cli observe`` can print the
+        knob-move timeline against the burn it was fighting."""
+        rec = {"type": "control_action", "knob": str(knob),
+               "old": float(old), "new": float(new),
+               "reason": str(reason),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if breaching_phase is not None:
+            rec["breaching_phase"] = str(breaching_phase)
+        if burn_rate_before is not None:
+            rec["burn_rate_before"] = round(float(burn_rate_before), 4)
+        if rollback is not None:
+            rec["rollback"] = bool(rollback)
+        if model is not None:
+            rec["model"] = str(model)
+        self.write(rec)
+
     def log_checkpoint(self, step, duration_ms, nbytes=None,
                        overlapped=None, step_thread_ms=None, pass_id=None,
                        path=None):
@@ -898,6 +922,20 @@ def summarize_dir(directory):
             # `cli observe` prints per-worker qps/occupancy next to the
             # per-replica lines
             run["serve_worker"] = meta.get("worker")
+        controls = [r for r in records
+                    if r.get("type") == "control_action"]
+        if controls:
+            # the knob-move timeline: what the SLO controller did to
+            # this run, in order — printed by `cli observe` next to the
+            # tail-attribution report so "why did the tail recover"
+            # has its answer on the same screen
+            run["control_actions"] = [
+                {k: r[k] for k in ("knob", "old", "new", "reason",
+                                   "breaching_phase", "burn_rate_before",
+                                   "rollback", "t") if k in r}
+                for r in controls]
+            run["control_rollbacks"] = sum(
+                1 for r in controls if r.get("rollback"))
         traced = [r for r in records if r.get("type") == "serve_trace"]
         if traced:
             from paddle_tpu.observe.tracing import tail_attribution

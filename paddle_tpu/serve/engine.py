@@ -334,6 +334,41 @@ class InferenceEngine:
         out["trace"] = observe_tracing.trace_state()
         return out
 
+    def register_knobs(self, registry, prefix="engine"):
+        """Adopt this engine's live-adjustable parameters into a
+        :class:`~paddle_tpu.control.knobs.KnobRegistry` (docs/
+        control.md). Each apply hook re-takes the engine cv — the same
+        lock every hot-path reader of these fields already holds — and
+        notifies it, so a deadline move wakes a worker currently
+        sleeping on the OLD deadline. ``max_queue_rows`` registers
+        only when a ceiling was configured: adopting an unbounded
+        queue would let the controller silently impose one."""
+        from paddle_tpu.control.knobs import Knob
+
+        with self._cv:
+            deadline = self.max_latency_ms
+            queue_rows = self.max_queue_rows
+
+        def _set_deadline(v):
+            with self._cv:
+                self.max_latency_ms = float(v)
+                self._cv.notify_all()
+
+        registry.register(Knob(
+            prefix + ".batch_deadline_ms", value=deadline,
+            min=0.25, max=500.0, step=0.5, apply=_set_deadline))
+        if queue_rows is not None:
+            def _set_queue_rows(v):
+                with self._cv:
+                    self.max_queue_rows = int(v)
+                    self._cv.notify_all()
+
+            registry.register(Knob(
+                prefix + ".max_queue_rows", value=queue_rows,
+                min=self.max_batch_size, max=1 << 20,
+                step=self.max_batch_size, integer=True,
+                apply=_set_queue_rows))
+
     def stop(self, timeout=30.0):
         """Drain the queue, stop the worker, close an engine-owned
         steplog (a shared one is flushed — ``flush_every`` batching

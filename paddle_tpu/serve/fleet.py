@@ -221,6 +221,13 @@ class ReplicaSet:
         self._members = tuple(members)
         self._lock = threading.Lock()
         self._rr = 0
+        # knob-settable dispatch width (docs/control.md): fresh
+        # stateless traffic concentrates on the first ``_active``
+        # members; sessions keep full-ring affinity and a narrowed
+        # fleet falls back to every eligible member rather than shed.
+        # Guarded by self._lock like _rr — the knob apply hook writes
+        # it while submit reads it.
+        self._active = len(members)
         # fleet-wide session affinity (docs/serving.md "Session tier &
         # paging"): sessions consistent-hash onto the replica ring so a
         # resumed session lands on the replica whose store holds its
@@ -297,6 +304,15 @@ class ReplicaSet:
                                         priority=priority,
                                         end_session=end_session,
                                         trace=trace)
+        with self._lock:
+            active = self._active
+        if active < len(self._members):
+            # the width knob narrows FRESH stateless dispatch only; if
+            # every member inside the width is dead, availability wins
+            # over the knob and the full eligible set serves
+            narrowed = [m for m in eligible if m.index < active]
+            if narrowed:
+                eligible = narrowed
         n = len(eligible)
         with self._lock:
             offset = self._rr
@@ -423,12 +439,57 @@ class ReplicaSet:
     def live_detail(self):
         return {str(m.index): m.engine.live() for m in self._members}
 
+    def register_knobs(self, registry, prefix="fleet"):
+        """Adopt the dispatch width plus the member engines' own knobs
+        as fleet-wide broadcasts (docs/control.md): each member
+        registers into a private registry, and names every member
+        shares become ONE fleet knob whose apply fans the move out to
+        all of them — the same shape the WorkerSet uses over its RPC
+        pipe, so the controller never cares which fleet flavor it is
+        steering."""
+        from paddle_tpu.control.knobs import Knob, KnobRegistry
+
+        def _set_active(v):
+            with self._lock:
+                self._active = int(v)
+
+        registry.register(Knob(
+            prefix + ".active_replicas", value=len(self._members),
+            min=1, max=len(self._members), step=1, integer=True,
+            cost_hint="heavy", apply=_set_active))
+        member_regs = []
+        for m in self._members:
+            if not hasattr(m.engine, "register_knobs"):
+                return
+            reg = KnobRegistry()
+            m.engine.register_knobs(reg)
+            member_regs.append(reg)
+        if not member_regs:
+            return
+        shared = set(member_regs[0].names())
+        for reg in member_regs[1:]:
+            shared &= set(reg.names())
+        for name in sorted(shared):
+            proto = member_regs[0].get(name)
+
+            def _broadcast(v, name=name):
+                for reg in member_regs:
+                    reg.set(name, v)
+
+            registry.register(Knob(
+                name, value=proto.value, min=proto.min, max=proto.max,
+                step=proto.step, cost_hint=proto.cost_hint,
+                integer=proto.integer, apply=_broadcast))
+
     def stats(self):
         """Fleet view: aggregate counters plus the full per-replica
         stats map (each member's own engine stats, replica-labeled)."""
         per = {str(m.index): m.engine.stats() for m in self._members}
+        with self._lock:
+            active = self._active
         out = {
             "replicas": len(self._members),
+            "active_replicas": active,
             "dispatch": "least_queued_rr",
             "devices": [str(m.device) for m in self._members],
             "per_replica": per,

@@ -151,7 +151,12 @@ class Router:
         through BY VALUE to the hosted engine — the router adds no span
         of its own, it is a synchronous hop on the caller's thread."""
         hosted = self.model(name)
-        ceiling = self.shed_capacity.get(hosted.priority)
+        # ceiling read under the router lock: shed_capacity was
+        # set-once at construction until the knob registry made it
+        # mutable (control/knobs.py) — an unlocked read here against a
+        # concurrent knob move is exactly the PTA005 pattern
+        with self._lock:
+            ceiling = self.shed_capacity.get(hosted.priority)
         if ceiling is not None:
             queued = self.total_queued()
             if queued >= ceiling:
@@ -209,16 +214,44 @@ class Router:
 
     def stats(self):
         models = self._hosted()
+        with self._lock:
+            shed_capacity = dict(self.shed_capacity)
         return {
             "models": {name: m.engine.stats()
                        for name, m in models.items()},
             "priorities": {name: m.priority
                            for name, m in models.items()},
             "total_queued": self.total_queued(),
-            "shed_capacity": dict(self.shed_capacity),
+            "shed_capacity": shed_capacity,
             "ready": self.ready(),
             "trace": observe_tracing.trace_state(),
         }
+
+    def register_knobs(self, registry, prefix="router"):
+        """Adopt the per-priority pressure ceilings (docs/control.md).
+        ``high`` has no ceiling by design (never shed) and is not
+        adoptable; ``normal``/``low`` register only when a ceiling is
+        configured — the controller lowers them to shed earlier when
+        the tail is queue-wait-dominated. The apply hook writes under
+        the router lock, paired with the locked read in
+        :meth:`submit`."""
+        from paddle_tpu.control.knobs import Knob
+
+        with self._lock:
+            ceilings = dict(self.shed_capacity)
+        for priority in ("normal", "low"):
+            ceiling = ceilings.get(priority)
+            if ceiling is None:
+                continue
+
+            def _apply(v, priority=priority):
+                with self._lock:
+                    self.shed_capacity[priority] = int(v)
+
+            registry.register(Knob(
+                "%s.shed_%s" % (prefix, priority), value=ceiling,
+                min=16, max=1 << 20, step=16, integer=True,
+                apply=_apply))
 
     def stop(self, timeout=30.0):
         for m in self._hosted().values():

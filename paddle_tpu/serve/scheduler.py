@@ -194,6 +194,14 @@ class ContinuousScheduler:
         self.paging = bool(paging)
         self.idle_spill_ms = (None if idle_spill_ms is None
                               else float(idle_spill_ms))
+        # knob-settable admission/paging budgets (docs/control.md), cv
+        # guarded like max_queue/idle_spill_ms. ``admit_budget`` caps
+        # FRESH admissions per planning iteration (parked continues are
+        # already in the window and never count); ``park_budget`` caps
+        # how many sessions may sit parked in slots before the LRU ones
+        # spill even without queue pressure. None = today's behavior.
+        self.admit_budget = None
+        self.park_budget = None
         self._labels = {"model": str(model)} if model else {}
         if self.replica is not None:
             self._labels["replica"] = self.replica
@@ -515,6 +523,56 @@ class ContinuousScheduler:
         out["trace"] = observe_tracing.trace_state()
         return out
 
+    def register_knobs(self, registry, prefix="sched"):
+        """Adopt the scheduler's live-adjustable parameters (docs/
+        control.md). NEVER the jit shapes — ``slots`` and ``window``
+        are baked into the decode artifact's traced computation, and
+        moving them would mint a compile, violating the controller's
+        zero-post-warmup-compiles contract. Apply hooks re-take the cv
+        (the lock every planner read of these fields holds) and notify
+        it so a lowered park budget spills immediately, not at the
+        next request. ``idle_spill_ms`` registers only when idle
+        spilling was configured; ``admit_budget``/``park_budget``
+        adopt at their behavior-neutral ceilings (``slots`` — a budget
+        of every slot changes nothing until the controller moves
+        it)."""
+        from paddle_tpu.control.knobs import Knob
+
+        with self._cv:
+            max_queue = self.max_queue
+            idle_spill_ms = self.idle_spill_ms
+            admit_budget = self.admit_budget
+            park_budget = self.park_budget
+
+        def _setter(attr, cast):
+            def _apply(v):
+                with self._cv:
+                    setattr(self, attr, cast(v))
+                    self._cv.notify_all()
+            return _apply
+
+        if max_queue is not None:
+            registry.register(Knob(
+                prefix + ".max_queue", value=max_queue,
+                min=self.slots, max=1 << 16, step=self.slots,
+                integer=True, apply=_setter("max_queue", int)))
+        if self.paging and idle_spill_ms is not None:
+            registry.register(Knob(
+                prefix + ".idle_spill_ms", value=idle_spill_ms,
+                min=1.0, max=600000.0, step=25.0,
+                apply=_setter("idle_spill_ms", float)))
+        registry.register(Knob(
+            prefix + ".admit_budget",
+            value=self.slots if admit_budget is None else admit_budget,
+            min=1, max=self.slots, step=1, integer=True,
+            apply=_setter("admit_budget", int)))
+        if self.paging:
+            registry.register(Knob(
+                prefix + ".park_budget",
+                value=self.slots if park_budget is None else park_budget,
+                min=0, max=self.slots, step=1, integer=True,
+                cost_hint="heavy", apply=_setter("park_budget", int)))
+
     def stop(self, timeout=30.0):
         """Drain queued and in-slot sequences, stop the worker and the
         spill writer, close an owned steplog. Idempotent. Parked and
@@ -724,16 +782,24 @@ class ContinuousScheduler:
         run even with ``paging=False`` — migration must work off a
         hard-cap scheduler too; only the idle threshold is a paging
         feature."""
+        parked = 0
         for slot in self._slots:
             ses = slot.session
             if ses is None or slot.req is not None:
                 continue
+            parked += 1
             if ses.sid in self._spill_asap:
                 return True
             if (self.paging and self.idle_spill_ms is not None
                     and (now - ses.last_active) * 1e3
                     >= self.idle_spill_ms):
                 return True
+        # park-budget overflow is also due work: when the knob drops
+        # below the current parked population the planner must wake and
+        # spill the LRU excess, not wait for the next request
+        if (self.paging and self.park_budget is not None
+                and parked > self.park_budget):
+            return True
         return False
 
     def _free_slot_possible_locked(self):
@@ -848,18 +914,41 @@ class ContinuousScheduler:
                     # writer's commit, not start a fresh zero carry
                     self._pending_spills[ses.sid] = True
                     self._detach_locked(i, spilling=True)
-            # 2. queue scan in arrival order
+            # 1b. park-budget pressure (docs/control.md): spill the LRU
+            # parked sessions beyond the budget even without queue
+            # pressure — the controller lowers this knob to trade
+            # resident carries for restore headroom
+            if self.paging and self.park_budget is not None:
+                parked = [(i, s.session) for i, s in enumerate(self._slots)
+                          if s.session is not None and s.req is None]
+                excess = len(parked) - int(self.park_budget)
+                if excess > 0:
+                    parked.sort(key=lambda t: t[1].last_active)
+                    for i, ses in parked[:excess]:
+                        plan.spills.append((i, ses))
+                        self._pending_spills[ses.sid] = True
+                        self._detach_locked(i, spilling=True)
+            # 2. queue scan in arrival order. ``admit_budget`` caps the
+            # FRESH admissions (sessionless, brand-new, restores) this
+            # iteration may add to the window — parked continues are
+            # already decoding here and never count against it
             leftovers = collections.deque()
+            admit_budget = self.admit_budget
+            fresh = 0
             while self._queue:
                 req = self._queue.popleft()
                 sid = req.session
                 if sid is None:
+                    if admit_budget is not None and fresh >= admit_budget:
+                        leftovers.append(req)
+                        continue
                     idx = self._claim_slot_locked(plan)
                     if idx is None:
                         leftovers.append(req)
                         continue
                     self._attach_locked(idx, req, now)
                     plan.admitted.append(idx)
+                    fresh += 1
                     continue
                 if sid in self._pending_spills:
                     if req.t_defer is None:
@@ -887,6 +976,9 @@ class ContinuousScheduler:
                     self._attach_locked(res_idx, req, now)
                     continue  # parked continue: reset=0, no restore
                 # suspended / brand-new / evicted
+                if admit_budget is not None and fresh >= admit_budget:
+                    leftovers.append(req)
+                    continue
                 try:
                     state = store.pop(sid)
                 except SessionGone as exc:
@@ -902,6 +994,7 @@ class ContinuousScheduler:
                     continue
                 self._attach_locked(idx, req, now,
                                     pos=0 if state is None else state.pos)
+                fresh += 1
                 if state is None:
                     plan.admitted.append(idx)
                 else:

@@ -183,6 +183,7 @@ class _Handler(_BaseHandler):
     engine = None
     bundle = None
     slo = None
+    controller = None
 
     def do_GET(self):
         if self.path == "/healthz":
@@ -208,6 +209,15 @@ class _Handler(_BaseHandler):
             self._send(200, observe_health.collect_traces([self.engine]))
         elif self.path == "/debug/slo":
             self._send(200, self.slo.evaluate())
+        elif self.path == "/debug/control":
+            # knob values + the recent action tape; 404 (not an empty
+            # body) without --autotune so probes can tell "controller
+            # off" from "controller idle"
+            if self.controller is None:
+                self._send(404, {"error": "no controller on this "
+                                          "server (serve --autotune)"})
+            else:
+                self._send(200, self.controller.snapshot())
         elif self.path == "/manifest":
             self._send(200, self.bundle.manifest)
         else:
@@ -240,6 +250,7 @@ class _RouterHandler(_BaseHandler):
 
     router = None
     slo = None
+    controller = None
 
     def do_GET(self):
         router = self.router
@@ -272,6 +283,12 @@ class _RouterHandler(_BaseHandler):
                 self._fronts()))
         elif self.path == "/debug/slo":
             self._send(200, self.slo.evaluate())
+        elif self.path == "/debug/control":
+            if self.controller is None:
+                self._send(404, {"error": "no controller on this "
+                                          "server (serve --autotune)"})
+            else:
+                self._send(200, self.controller.snapshot())
         elif self.path == "/manifest":
             try:
                 self._send(200, router.default_model().bundle.manifest)
@@ -320,41 +337,51 @@ class _RouterHandler(_BaseHandler):
                 for name in self.router.models()]
 
 
-def make_server(bundle, engine, host="127.0.0.1", port=0, slo=None):
+def make_server(bundle, engine, host="127.0.0.1", port=0, slo=None,
+                controller=None):
     """Single-model server bound to (host, port); ``port=0`` picks a
     free port (``server.server_address[1]`` is the actual one).
     ``slo=`` is an :class:`~paddle_tpu.observe.health.SloMonitor`; when
     omitted a no-objective monitor is built so ``GET /debug/slo``
-    always answers (state ``no_objective``, burn rates zero)."""
+    always answers (state ``no_objective``, burn rates zero).
+    ``controller=`` (a :class:`~paddle_tpu.control.controller
+    .Controller`) enables ``GET /debug/control``."""
     if slo is None:
         slo = observe_health.SloMonitor([engine])
     handler = type("BundleHandler", (_Handler,),
-                   {"engine": engine, "bundle": bundle, "slo": slo})
+                   {"engine": engine, "bundle": bundle, "slo": slo,
+                    "controller": controller})
     return ThreadingHTTPServer((host, port), handler)
 
 
-def make_router_server(router, host="127.0.0.1", port=0, slo=None):
+def make_router_server(router, host="127.0.0.1", port=0, slo=None,
+                       controller=None):
     """Multi-model server over a :class:`~paddle_tpu.serve.router
     .Router` (POST /infer/<model>, per-model /readyz, 429 shedding)."""
     if slo is None:
         slo = observe_health.SloMonitor(
             [router.model(name).engine for name in router.models()])
     handler = type("RouterHandler", (_RouterHandler,),
-                   {"router": router, "slo": slo})
+                   {"router": router, "slo": slo,
+                    "controller": controller})
     return ThreadingHTTPServer((host, port), handler)
 
 
-def serve_in_thread(bundle, engine, host="127.0.0.1", port=0, slo=None):
+def serve_in_thread(bundle, engine, host="127.0.0.1", port=0, slo=None,
+                    controller=None):
     """Start a single-model server on a daemon thread; returns
     (server, thread) — tests and notebooks use this, the CLI uses
     serve_forever."""
-    return _spawn(make_server(bundle, engine, host, port, slo=slo))
+    return _spawn(make_server(bundle, engine, host, port, slo=slo,
+                              controller=controller))
 
 
-def serve_router_in_thread(router, host="127.0.0.1", port=0, slo=None):
+def serve_router_in_thread(router, host="127.0.0.1", port=0, slo=None,
+                           controller=None):
     """Start a multi-model router server on a daemon thread; returns
     (server, thread)."""
-    return _spawn(make_router_server(router, host, port, slo=slo))
+    return _spawn(make_router_server(router, host, port, slo=slo,
+                                     controller=controller))
 
 
 def _spawn(server):

@@ -429,6 +429,17 @@ def _worker_main(index, bundle_dir, continuous, engine_kwargs, model,
                             model=model, replica=index, steplog=slog,
                             **dict(engine_kwargs or {}))
 
+        # worker-local knob registry (docs/control.md): the router-side
+        # WorkerSet discovers these over the "knobs" verb and fans
+        # controller moves out over "set_knob" — the apply hooks run
+        # HERE, in the process that owns the engine's locks
+        knob_reg = None
+        if hasattr(engine, "register_knobs"):
+            from paddle_tpu.control.knobs import KnobRegistry
+
+            knob_reg = KnobRegistry()
+            engine.register_knobs(knob_reg)
+
         stop_evt = threading.Event()
         out_q = collections.deque()
         out_cv = threading.Condition()
@@ -563,6 +574,16 @@ def _worker_main(index, bundle_dir, continuous, engine_kwargs, model,
                 elif op == "compiles":
                     rpc.send({"ok": True,
                               "compiles": watcher.compiles})
+                elif op == "knobs":
+                    rpc.send({"ok": True,
+                              "knobs": (knob_reg.snapshot()
+                                        if knob_reg is not None else {})})
+                elif op == "set_knob":
+                    if knob_reg is None:
+                        raise KeyError(str(header.get("knob")))
+                    old, new = knob_reg.set(str(header["knob"]),
+                                            header["value"])
+                    rpc.send({"ok": True, "old": old, "new": new})
                 elif op == "stop":
                     break
                 elif op in ("has_session", "close_session",
@@ -1443,6 +1464,41 @@ class WorkerSet:
             out["hbm_estimate_bytes"] = self.hbm_estimate_bytes
         out["ready"] = self.ready()
         return out
+
+    def register_knobs(self, registry):
+        """Adopt the workers' knobs as fleet-wide proxies (docs/
+        control.md): discover the knob table from the first worker
+        that answers the ``knobs`` verb, then register one proxy per
+        name whose apply broadcasts ``set_knob`` over every live
+        worker's control pipe. Best-effort by design — a worker that
+        is mid-restart misses a move and simply keeps its old value
+        until the next one; the controller's rollback guard judges
+        outcomes, not deliveries."""
+        from paddle_tpu.control.knobs import Knob
+
+        table = {}
+        for handle in self._handles:
+            if handle.dead() or not handle.is_alive():
+                continue
+            reply = handle.try_rpc({"op": "knobs"}, timeout=5.0)
+            if reply is not None and reply.get("knobs"):
+                table = reply["knobs"]
+                break
+        for name in sorted(table):
+            desc = table[name]
+
+            def _broadcast(v, name=name):
+                for handle in self._handles:
+                    if handle.dead() or not handle.is_alive():
+                        continue
+                    handle.try_rpc({"op": "set_knob", "knob": name,
+                                    "value": v}, timeout=5.0)
+
+            registry.register(Knob(
+                name, value=desc["value"], min=desc["min"],
+                max=desc["max"], step=desc["step"],
+                cost_hint=desc.get("cost_hint", "cheap"),
+                integer=bool(desc.get("integer")), apply=_broadcast))
 
     def compile_counts(self):
         """Per-worker compile counters (the in-worker ``watch_compiles``
