@@ -139,6 +139,15 @@ HOT_PATHS = {
     "observe/health.py": {"record_request", "record_shed",
                           "record_queue_depth", "record_occupancy",
                           "snapshot"},
+    # the training-side twin: record_step/record_chunk run inside the
+    # trainer's per-step finalize, record_checkpoint on every cadence
+    # hit, and snapshot shares their lock — same fleet-wide stall
+    # hazard as the serving recorder above
+    "observe/trainview.py": {"record_step", "record_chunk",
+                             "record_checkpoint", "snapshot"},
+    # the elastic driver: its membership-watch handler closure runs at
+    # EVERY step boundary (EndIteration), nested inside run_elastic
+    "distributed/elastic.py": {"run_elastic"},
     # the quantized-bundle dequant hook is traced INTO every exported
     # program (serve/export.py), so a stray host sync in it would land
     # on every serving dispatch of every quantized bundle

@@ -687,6 +687,19 @@ def cmd_observe(args):
         regress_results = results
         if regressions:
             rc = 1
+    if getattr(args, "fleet_stats", None):
+        # live membership next to the post-hoc file view: the
+        # coordinator's fleet_stats verb answers "who is alive RIGHT
+        # NOW and how stale is each lease" (short retry window — an
+        # observability query must not hang behind a dead coordinator)
+        from paddle_tpu.distributed.client import CoordinatorClient
+
+        client = CoordinatorClient(args.fleet_stats, worker_id="observe",
+                                   retry_timeout=5.0)
+        try:
+            summary["fleet_stats"] = client.fleet_stats()
+        finally:
+            client.close()
     if args.json:
         if regress_results is not None:
             summary["regress"] = regress_results
@@ -803,6 +816,61 @@ def cmd_observe(args):
             for widx, w in sorted(fleet["workers"].items(),
                                   key=lambda kv: int(kv[0])))
         print("    per-worker: %s" % breakdown)
+    tf = summary.get("train_fleet")
+    if tf:
+        # the training-fleet block (observe/trainview.py): per-worker
+        # step-time skew against the fleet-pooled median, the straggler
+        # verdict, and the merged elastic timeline
+        skew = tf.get("skew")
+        if skew:
+            straggler = tf.get("straggler")
+            rewinds = ("  rewinds %d" % tf["rewinds"]
+                       if tf.get("rewinds") else "")
+            print("  training fleet: %d worker(s), fleet median "
+                  "%.3f ms/step%s"
+                  % (len(skew["workers"]), skew["fleet_median_ms"],
+                     rewinds))
+            for wid, w in sorted(skew["workers"].items()):
+                mark = (" <- straggler" if straggler
+                        and straggler["worker"] == wid else "")
+                print("    worker %-12s steps %-5d p50 %.3f ms  "
+                      "p95 %.3f ms  skew %.2f%s"
+                      % (wid, w.get("steps", 0), w["p50_ms"],
+                         w["p95_ms"], w["skew"], mark))
+            if straggler:
+                from paddle_tpu.observe.trainview import (
+                    DEFAULT_SKEW_THRESHOLD)
+
+                print("    straggler: %s (skew %.2f >= %.2f)"
+                      % (straggler["worker"], straggler["skew"],
+                         DEFAULT_SKEW_THRESHOLD))
+        timeline = tf.get("timeline")
+        if timeline:
+            print("  elastic timeline: %d event(s)" % len(timeline))
+            for e in timeline:
+                extras = []
+                if e.get("members") is not None:
+                    extras.append("members=[%s]"
+                                  % ",".join(e["members"]))
+                if e.get("lost") is not None:
+                    extras.append("lost=[%s]" % ",".join(e["lost"]))
+                if e.get("checkpoint"):
+                    extras.append("checkpoint=%s" % e["checkpoint"])
+                if e.get("step") is not None:
+                    extras.append("step=%d" % e["step"])
+                if e.get("detail"):
+                    extras.append("(%s)" % e["detail"])
+                print("    at=%.3f %-18s worker=%-12s %s"
+                      % (e["at"], e["kind"], e.get("worker", "-"),
+                         "  ".join(extras)))
+    stats = summary.get("fleet_stats")
+    if stats:
+        ws = stats.get("workers", [])
+        print("  live fleet (%s): %d worker(s)"
+              % (args.fleet_stats, len(ws)))
+        for w in ws:
+            print("    %-12s lease remaining %.1fs"
+                  % (w["id"], w["lease_remaining"]))
     if summary["trace_files"]:
         print("  traces (open in https://ui.perfetto.dev): %s"
               % ", ".join(summary["trace_files"]))
@@ -1005,6 +1073,10 @@ def main(argv=None):
     p.add_argument("--regress-tol", type=float, default=10.0,
                    help="base tolerance %% before the row's own "
                         "spread_pct widens it")
+    p.add_argument("--fleet-stats", default="", metavar="HOST:PORT",
+                   help="also query the task coordinator's fleet_stats "
+                        "verb: live training-fleet membership + per-"
+                        "lease time-to-expiry next to the file view")
     p.set_defaults(fn=cmd_observe)
 
     p = sub.add_parser("analyze")

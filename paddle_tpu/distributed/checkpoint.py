@@ -641,3 +641,12 @@ class AsyncCheckpointer:
                 step=job.step, duration_ms=duration_ms, nbytes=nbytes,
                 overlapped=True, step_thread_ms=job.step_thread_ms,
                 pass_id=job.pass_id, path=os.path.basename(path))
+            # the commit also lands on the elastic timeline: a fleet's
+            # merged report shows WHICH committed checkpoint a later
+            # rewind could target (observe/trainview.py)
+            from paddle_tpu.observe import trainview as observe_trainview
+
+            self._steplog.log_elastic_event(
+                "checkpoint_commit",
+                worker=observe_trainview.worker_id(), step=job.step,
+                checkpoint=os.path.basename(path))

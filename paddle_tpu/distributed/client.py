@@ -192,6 +192,14 @@ class CoordinatorClient:
     def workers(self):
         return self.call("workers").get("workers", [])
 
+    def fleet_stats(self):
+        """Live membership with per-lease time-to-expiry:
+        ``{"now": <coordinator clock>, "workers": [{"id", "lease_remaining"},
+        ...]}`` — the observability verb behind ``cli observe
+        --fleet-stats`` (negative lease_remaining = lapsed, not yet
+        swept)."""
+        return self.call("fleet_stats")
+
     def request_save_model(self, ttl=60.0):
         """True iff this worker wins the save election (exactly one does
         per ttl window — reference RequestSaveModel semantics)."""

@@ -223,6 +223,7 @@ class Service {
     if (op == "heartbeat") return heartbeat(req);
     if (op == "register") return register_worker(req);
     if (op == "workers") return list_workers();
+    if (op == "fleet_stats") return fleet_stats();
     if (op == "request_save_model") return request_save_model(req);
     if (op == "status") return status();
     if (op == "snapshot") { snapshot(); return R"({"ok": true})"; }
@@ -367,6 +368,27 @@ class Service {
     for (auto& kv : workers_) {
       if (!first) os << ", ";
       os << '"' << json_escape(kv.first) << '"';
+      first = false;
+    }
+    os << "]}";
+    return os.str();
+  }
+
+  std::string fleet_stats() {
+    // live training-fleet membership with per-lease time-to-expiry —
+    // the observability verb behind `cli observe --fleet-stats`
+    // (observe/trainview.py): "who is alive RIGHT NOW and how stale is
+    // each lease", where the steplog timeline only answers "what
+    // happened". Negative lease_remaining = lapsed but not yet swept
+    // by tick().
+    double t = now_sec();
+    std::ostringstream os;
+    os << "{\"ok\": true, \"now\": " << t << ", \"workers\": [";
+    bool first = true;
+    for (auto& kv : workers_) {
+      if (!first) os << ", ";
+      os << "{\"id\": \"" << json_escape(kv.first)
+         << "\", \"lease_remaining\": " << (kv.second - t) << "}";
       first = false;
     }
     os << "]}";

@@ -29,6 +29,7 @@ with ``--resume``, and the checkpoint makes that restart cheap; see
 docs/distributed.md "Lost-worker recovery".
 """
 
+import os
 import threading
 import time
 
@@ -65,7 +66,7 @@ class HeartbeatThread:
     anything escaping that is counted, logged and survived — a missed
     beat only matters if ttl lapses, which is the coordinator's call."""
 
-    def __init__(self, endpoint, worker_id, ttl=10.0):
+    def __init__(self, endpoint, worker_id, ttl=10.0, steplog=None):
         from paddle_tpu.distributed.client import CoordinatorClient
 
         self.ttl = float(ttl)
@@ -75,6 +76,9 @@ class HeartbeatThread:
         # out the full default retry window behind a dead coordinator
         self._client = CoordinatorClient(endpoint, worker_id=worker_id,
                                          retry_timeout=self.ttl)
+        # elastic-event sink for lease_renew_fail records (StepLog.write
+        # is locked, so this thread shares the owner's log safely)
+        self._steplog = steplog
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._beats = 0
@@ -130,6 +134,16 @@ class HeartbeatThread:
                 with self._lock:
                     self._errors += 1
                 logger.warning("coordinator heartbeat failed: %s", exc)
+                if self._steplog is not None:
+                    # a missed beat is timeline-worthy (the prelude to a
+                    # worker_lost seen elsewhere) but never fatal here
+                    try:
+                        self._steplog.log_elastic_event(
+                            "lease_renew_fail",
+                            worker=self._client.worker_id,
+                            detail=str(exc))
+                    except Exception:
+                        pass
 
 
 def settled_members(client, poll_secs=0.1, expected=None, timeout=30.0):
@@ -265,10 +279,34 @@ def run_elastic(trainer, endpoint, chunks, reader_of, checkpoint_dir,
     from paddle_tpu import event as v2_event
     from paddle_tpu.distributed import checkpoint as ckpt_mod
     from paddle_tpu.distributed.client import CoordinatorClient
+    from paddle_tpu.observe import metrics as observe_metrics
+    from paddle_tpu.observe import steplog as observe_steplog
+    from paddle_tpu.observe import trainview as observe_trainview
 
     client = CoordinatorClient(endpoint, worker_id=worker_id)
+    # the elastic timeline gets its OWN per-worker steplog (run name
+    # "elastic-t<i>"), distinct from the trainer's "train-t<i>" files:
+    # the driver outlives every train() call it makes, and the events it
+    # emits (register, worker_lost, rewind...) belong to the driver's
+    # clock, not any one training attempt's
+    slog = observe_steplog.from_env(
+        run_name=observe_trainview.worker_run_name("elastic",
+                                                   client.worker_id),
+        meta={"phase": "elastic", "worker": client.worker_id})
+
+    def emit(kind, **kw):
+        if slog is not None:
+            slog.log_elastic_event(kind, worker=client.worker_id, **kw)
+
+    m = observe_metrics.get_registry()
+    g_workers = m.gauge("paddle_tpu_train_workers",
+                        help="live elastic membership at the last deal")
+    c_rewinds = m.counter("paddle_tpu_train_rewinds_total",
+                          help="checkpoint rewinds after a lost worker")
     hb = HeartbeatThread(endpoint, client.worker_id,
-                         ttl=heartbeat_ttl).start()
+                         ttl=heartbeat_ttl, steplog=slog).start()
+    emit("register",
+         members=sorted(set(client.workers()) | {client.worker_id}))
     stats = {"reforms": 0, "lost": [], "deals": []}
     resume = False
     try:
@@ -295,6 +333,13 @@ def run_elastic(trainer, endpoint, chunks, reader_of, checkpoint_dir,
                           else None))
             mine = deal_shards(chunks, members, client.worker_id)
             stats["deals"].append(list(mine))
+            g_workers.set(len(members))
+            # every deal (the first included) lands on the timeline: the
+            # merged report shows each worker's view of who dealt what
+            emit("re_deal", members=sorted(members),
+                 detail="%d of %d shards" % (len(mine), len(chunks)))
+            if resume:
+                emit("resume", members=sorted(members))
             watch = MembershipWatch(client, members, poll_secs=poll_secs)
 
             def handler(evt, _watch=watch):
@@ -319,9 +364,15 @@ def run_elastic(trainer, endpoint, chunks, reader_of, checkpoint_dir,
                               resume=("pass" if resume else False),
                               **train_kw)
                 return stats
+            except SelfLeaseLost:
+                emit("self_lease_lost")
+                raise
             except WorkerLost as exc:
                 stats["reforms"] += 1
                 stats["lost"].extend(exc.lost)
+                emit("worker_lost", members=sorted(exc.remaining),
+                     lost=exc.lost)
+                c_rewinds.inc()
                 enforce(stats["reforms"] <= max_reforms,
                         "gave up after %d mesh re-formations (last: %s)",
                         stats["reforms"], exc)
@@ -332,8 +383,14 @@ def run_elastic(trainer, endpoint, chunks, reader_of, checkpoint_dir,
                 # survivors abort at different boundaries: wait for the
                 # shared directory to stop changing before the restore,
                 # so every survivor rewinds to the SAME checkpoint
-                settled_checkpoint(checkpoint_dir, poll_secs=poll_secs)
+                target = settled_checkpoint(checkpoint_dir,
+                                            poll_secs=poll_secs)
+                emit("rewind", members=sorted(exc.remaining),
+                     checkpoint=(None if target is None
+                                 else os.path.basename(target)))
                 resume = True
     finally:
         hb.stop()
         client.close()
+        if slog is not None:
+            slog.close()

@@ -19,6 +19,7 @@ Run via `python -m paddle_tpu.distributed.worker ...` (the launcher does).
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -70,6 +71,12 @@ def main(argv=None):
                          "launcher) detect its death by lease lapse")
     ap.add_argument("--lease-ttl", type=float, default=10.0)
     args = ap.parse_args(argv)
+
+    # training-fleet identity (observe/trainview.py): stamp this
+    # process's worker id before any telemetry opens, so the trainer's
+    # steplog meta/file name, the sentinel's crash records and the
+    # metric labels all name it — overwrite, the launcher's choice wins
+    os.environ["PADDLE_TPU_TRAIN_WORKER"] = "trainer-%d" % args.process_id
 
     if args.use_tpu:
         import paddle_tpu as paddle

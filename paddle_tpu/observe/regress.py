@@ -45,9 +45,13 @@ GATE_ENV = "PADDLE_TPU_BENCH_GATE"
 # SLO rows (observe/health.py — error budget burning faster is a
 # regression, same as a latency row). "convergence_steps" gates the
 # slo-ab controller rows (control/controller.py — more knob moves to
-# reach the hand-tuned envelope means a slower control loop).
+# reach the hand-tuned envelope means a slower control loop). "skew"
+# gates the training-fleet straggler rows (observe/trainview.py —
+# worker p95 / fleet median; a fleet drifting further from uniform
+# step time is a regression).
 _LOWER_BETTER_UNITS = ("ms/batch", "ms/step", "ms", "s", "pct_waste",
-                       "bytes", "burn_rate", "convergence_steps")
+                       "bytes", "burn_rate", "convergence_steps",
+                       "skew")
 _HIGHER_BETTER_UNITS = ("samples/s", "qps", "MB/s", "checks_passed",
                         "checks", "replicas")
 

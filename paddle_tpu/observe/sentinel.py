@@ -126,7 +126,8 @@ class FlightRecorder:
                 body["reason"], body["steps"], captured=body["captured"],
                 capacity=body["capacity"], mode=body.get("mode"),
                 anomaly=body.get("anomaly"), artifact=path,
-                suppressed_trips=body.get("suppressed_trips"))
+                suppressed_trips=body.get("suppressed_trips"),
+                worker=body.get("worker"))
         return path
 
 
@@ -139,12 +140,16 @@ class Sentinel:
                  artifact_dir=None, run_name="train",
                  divergence_factor=DEFAULT_DIVERGENCE_FACTOR,
                  warmup_steps=DEFAULT_WARMUP_STEPS,
-                 capacity=DEFAULT_CAPACITY):
+                 capacity=DEFAULT_CAPACITY, worker=None):
         self.mode = mode or sentinel_mode()
         self.recorder = recorder or FlightRecorder(capacity=capacity)
         self.steplog = steplog
         self.artifact_dir = artifact_dir
         self.run_name = run_name
+        # training-fleet worker id (observe/trainview.py): stamped into
+        # every anomaly/crash_report this sentinel emits, so a
+        # multi-worker NaN names its process
+        self.worker = worker
         self.divergence_factor = float(divergence_factor)
         self.warmup_steps = int(warmup_steps)
         self._finite_seen = 0
@@ -255,6 +260,8 @@ class Sentinel:
         self._tripped_kinds.add(kind)
         anomaly = {"type": "anomaly", "step": int(step), "kind": kind,
                    "mode": self.mode}
+        if self.worker is not None:
+            anomaly["worker"] = str(self.worker)
         if pass_id is not None:
             anomaly["pass"] = int(pass_id)
         if cost is not None:
@@ -301,10 +308,13 @@ class Sentinel:
                 cost=anomaly.get("cost"),
                 threshold=anomaly.get("threshold"), mode=self.mode,
                 pass_id=anomaly.get("pass"),
-                chunk_index=anomaly.get("chunk_index"))
+                chunk_index=anomaly.get("chunk_index"),
+                worker=anomaly.get("worker"))
 
     def _dump(self, reason, anomaly):
         extra = {"mode": self.mode}
+        if self.worker is not None:
+            extra["worker"] = str(self.worker)
         if anomaly is not None:
             extra["anomaly"] = dict(anomaly)
         if self._suppressed:

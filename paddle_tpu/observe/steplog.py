@@ -98,13 +98,26 @@ Record types (field ``type``):
   ``pass`` and ``path`` (checkpoint directory basename).
 * ``anomaly`` — a sentinel trip (observe/sentinel.py): ``step``,
   ``kind`` (``nan_inf_loss``/``loss_divergence``), optional ``cost``
-  (repr string when non-finite), ``threshold``, ``mode``, ``pass``.
+  (repr string when non-finite), ``threshold``, ``mode``, ``pass``,
+  ``worker`` (the training-fleet worker id — a multi-worker NaN names
+  its process).
 * ``crash_report`` — the flight-recorder black box, written on a
   sentinel trip or an exception escaping the training loop: ``reason``
   and ``steps`` (the ring of the last N step records, oldest first),
   optional ``captured`` (lifetime records), ``capacity``, ``mode``,
   ``anomaly``, ``artifact`` (the standalone JSON path),
-  ``suppressed_trips`` (repeat trips of an already-reported kind).
+  ``suppressed_trips`` (repeat trips of an already-reported kind),
+  ``worker`` (the training-fleet worker id).
+* ``elastic_event`` — one elastic-fleet transition
+  (distributed/elastic.py, distributed/checkpoint.py commits):
+  ``kind`` in ``register``/``lease_renew_fail``/``self_lease_lost``/
+  ``worker_lost``/``rewind``/``re_deal``/``checkpoint_commit``/
+  ``resume``, optional ``worker`` (the emitting worker id),
+  ``members`` (the membership snapshot AT the event), ``lost``
+  (the lapsed workers, ``worker_lost`` only), ``checkpoint``
+  (directory basename, ``rewind``/``checkpoint_commit``), ``step``,
+  ``detail``. ``cli observe`` merges these across a fleet's files into
+  one absolute-time-ordered timeline (observe/trainview.py).
 * ``end``   — last line: total ``steps`` written.
 
 Unknown analysis code must ignore record types it does not know; within
@@ -685,10 +698,13 @@ class StepLog:
         self.write(rec)
 
     def log_anomaly(self, step, kind, cost=None, threshold=None,
-                    mode=None, pass_id=None, chunk_index=None):
+                    mode=None, pass_id=None, chunk_index=None,
+                    worker=None):
         """One sentinel trip (observe/sentinel.py). ``chunk_index`` is
         the offending step's position inside a fused chunk (trainer
-        ``steps_per_call=``), when the trip came from a chunk scan."""
+        ``steps_per_call=``), when the trip came from a chunk scan;
+        ``worker`` is the training-fleet worker id, so a multi-worker
+        NaN names its process."""
         rec = {"type": "anomaly", "step": int(step), "kind": str(kind),
                "t": round(time.perf_counter() - self._t0, 4)}
         if cost is not None:
@@ -701,11 +717,14 @@ class StepLog:
             rec["pass"] = int(pass_id)
         if chunk_index is not None:
             rec["chunk_index"] = int(chunk_index)
+        if worker is not None:
+            rec["worker"] = str(worker)
         self.write(rec)
 
     def log_crash_report(self, reason, steps, captured=None,
                          capacity=None, mode=None, anomaly=None,
-                         artifact=None, suppressed_trips=None):
+                         artifact=None, suppressed_trips=None,
+                         worker=None):
         """The flight-recorder black box: ``steps`` is the ring of the
         last N step records, oldest first (observe/sentinel.py)."""
         rec = {"type": "crash_report", "reason": str(reason),
@@ -723,6 +742,33 @@ class StepLog:
             rec["artifact"] = str(artifact)
         if suppressed_trips:
             rec["suppressed_trips"] = int(suppressed_trips)
+        if worker is not None:
+            rec["worker"] = str(worker)
+        self.write(rec)
+
+    def log_elastic_event(self, kind, worker=None, members=None,
+                          lost=None, checkpoint=None, step=None,
+                          detail=None):
+        """One elastic-fleet transition (distributed/elastic.py run
+        loop / heartbeat, distributed/checkpoint.py commits):
+        registration, lease trouble, membership loss, the rewind /
+        re-deal recovery path, checkpoint commits, resume. ``members``
+        is the membership snapshot AT the event, so the merged fleet
+        timeline shows the fleet reshaping around a loss."""
+        rec = {"type": "elastic_event", "kind": str(kind),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if worker is not None:
+            rec["worker"] = str(worker)
+        if members is not None:
+            rec["members"] = [str(m) for m in members]
+        if lost is not None:
+            rec["lost"] = [str(m) for m in lost]
+        if checkpoint is not None:
+            rec["checkpoint"] = str(checkpoint)
+        if step is not None:
+            rec["step"] = int(step)
+        if detail is not None:
+            rec["detail"] = str(detail)
         self.write(rec)
 
     def log_pass(self, pass_id, metrics=None):
@@ -753,13 +799,20 @@ class StepLog:
 
 
 def read_jsonl(path):
-    """Parse a steplog JSONL file into a list of record dicts."""
+    """Parse a steplog JSONL file into a list of record dicts.
+    Undecodable lines are skipped, not fatal: a kill -9 can tear the
+    final line of a dead worker's log mid-write, and the fleet report
+    over a shared telemetry dir must still merge the survivors."""
     records = []
     with open(path) as fh:
         for line in fh:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 records.append(json.loads(line))
+            except ValueError:
+                continue
     return records
 
 
@@ -836,6 +889,8 @@ def summarize_dir(directory):
 
     runs = []
     fleet_traced = {}  # base run name -> {worker index: [serve_trace]}
+    train_workers = {}  # worker id -> pooled steady walls/steps/files
+    elastic_events = []  # (meta unix_time, elastic_event record) pairs
     for path in sorted(glob.glob(os.path.join(directory, "*.steps.jsonl"))):
         records = read_jsonl(path)
         steps = [r for r in records if r.get("type") == "step"]
@@ -916,12 +971,39 @@ def summarize_dir(directory):
         serve = _serve_replica_summary(records)
         if serve:
             run["serve_replicas"] = serve
-        if meta.get("worker") is not None:
+        if (meta.get("worker") is not None
+                and meta.get("phase") not in ("train", "elastic")):
             # per-worker steplog file of a multi-process WorkerSet
             # (<run>-w<i>.steps.jsonl): surface the worker index so
             # `cli observe` prints per-worker qps/occupancy next to the
             # per-replica lines
             run["serve_worker"] = meta.get("worker")
+        if meta.get("phase") == "train" and meta.get("worker") is not None:
+            # per-worker TRAINING steplog (<run>-t<i>.steps.jsonl,
+            # observe/trainview.py): pool this file's steady-state
+            # per-step walls under the fleet worker id — one worker can
+            # own several files (a rewound run reopens with a -N
+            # suffix), and the skew detector wants them all
+            run["train_worker"] = meta.get("worker")
+            d = train_workers.setdefault(
+                str(meta.get("worker")),
+                {"walls": [], "steps": 0, "examples": 0, "files": []})
+            d["walls"].extend(walls[1:] or walls)
+            d["steps"] += len(steps)
+            # fused runs carry examples on the chunk, not the step
+            d["examples"] += (sum(r.get("examples", 0) for r in steps)
+                              or sum(c.get("examples", 0)
+                                     for c in chunks))
+            d["files"].append(os.path.basename(path))
+        elastic = [r for r in records
+                   if r.get("type") == "elastic_event"]
+        if elastic:
+            run["elastic_events"] = len(elastic)
+            # stamp with this FILE's wall-clock epoch: each record's t
+            # is relative to its own meta line, so cross-file ordering
+            # needs the absolute base (observe/trainview.py)
+            base_t = meta.get("unix_time") or 0.0
+            elastic_events.extend((base_t, r) for r in elastic)
         controls = [r for r in records
                     if r.get("type") == "control_action"]
         if controls:
@@ -1003,4 +1085,12 @@ def summarize_dir(directory):
     out = {"directory": directory, "runs": runs, "trace_files": traces}
     if fleets:
         out["fleets"] = fleets
+    if train_workers or elastic_events:
+        # the training-fleet block: per-worker step-time skew + the
+        # straggler verdict + the merged elastic timeline
+        from paddle_tpu.observe import trainview
+
+        fleet = trainview.fleet_summary(train_workers, elastic_events)
+        if fleet:
+            out["train_fleet"] = fleet
     return out

@@ -36,6 +36,7 @@ from paddle_tpu.observe import metrics as observe_metrics
 from paddle_tpu.observe import sentinel as observe_sentinel
 from paddle_tpu.observe import spans as observe_spans
 from paddle_tpu.observe import steplog as observe_steplog
+from paddle_tpu.observe import trainview as observe_trainview
 from paddle_tpu.utils.stat import global_stats
 
 
@@ -344,7 +345,17 @@ class SGD:
         meta = {"phase": "train", "num_passes": int(num_passes)}
         if k:
             meta["steps_per_call"] = k
-        slog = observe_steplog.from_env(meta=meta)
+        # training-fleet identity (observe/trainview.py): a distributed
+        # worker stamps PADDLE_TPU_TRAIN_WORKER before training, and
+        # every artifact this run emits carries it — the steplog meta
+        # plus a per-worker file name (train-t<i>.steps.jsonl), so
+        # `cli observe` can pool a shared telemetry directory by worker
+        wid = observe_trainview.worker_id()
+        run_name = "train"
+        if wid is not None:
+            meta["worker"] = wid
+            run_name = observe_trainview.worker_run_name("train", wid)
+        slog = observe_steplog.from_env(run_name=run_name, meta=meta)
         prev_recording = tracer.record_events
         if slog is not None:
             # telemetry may be flag-configured (no env var), so force
@@ -356,7 +367,9 @@ class SGD:
         # sentinel.py): cheap host checks on the already-read-back cost,
         # PADDLE_TPU_SENTINEL governs warn/halt/off; the crash artifact
         # lands next to the steplog when telemetry is on
-        sentinel = observe_sentinel.from_env(steplog=slog)
+        sentinel = observe_sentinel.from_env(steplog=slog,
+                                             run_name=run_name,
+                                             worker=wid)
         start_pass = start_cursor = 0
         if checkpoint_dir and resume:
             start_pass, start_cursor = self._resume_restore(checkpoint_dir,
@@ -440,14 +453,20 @@ class SGD:
     @staticmethod
     def _train_metrics():
         m = observe_metrics.get_registry()
+        # a training-fleet worker labels its series so a shared scrape
+        # keeps the processes apart (observe/trainview.py)
+        wid = observe_trainview.worker_id()
+        labels = {"worker": wid} if wid is not None else None
         return (m.counter("paddle_tpu_train_steps_total",
-                          help="finalized training steps"),
+                          help="finalized training steps", labels=labels),
                 m.counter("paddle_tpu_train_examples_total",
-                          help="examples consumed by training steps"),
+                          help="examples consumed by training steps",
+                          labels=labels),
                 m.gauge("paddle_tpu_train_loss",
-                        help="last finalized step loss"),
+                        help="last finalized step loss", labels=labels),
                 m.gauge("paddle_tpu_train_examples_per_sec",
-                        help="examples/s of the last finalized step"))
+                        help="examples/s of the last finalized step",
+                        labels=labels))
 
     def _train_passes(self, reader, num_passes, event_handler, feeding,
                       sync_params, test_reader, log_period, test_period,
@@ -455,6 +474,9 @@ class SGD:
                       start_pass=0, start_cursor=0, ckpt=None):
         (m_steps, m_examples, m_loss,
          m_examples_per_sec) = self._train_metrics()
+        # per-worker windowed health (observe/trainview.py): the fleet
+        # view's live counterpart to the steplog, O(1) memory
+        thist = observe_trainview.get_train_history()
         # ONE feeder across passes (batches() starts a fresh producer
         # thread per pass) so its cumulative per-bucket fill/waste
         # gauges span the whole run, like the serve engine's
@@ -518,6 +540,8 @@ class SGD:
                 m_loss.set(loss)
                 if wall_ms > 0:
                     m_examples_per_sec.set(n_examples / wall_ms * 1000.0)
+                thist.record_step(wall_ms, examples=n_examples,
+                                  feed_stall_ms=feed_ms)
                 if sentinel is not None:
                     # halt mode raises TrainingAnomaly here (black box
                     # already dumped by the sentinel itself)
@@ -661,6 +685,8 @@ class SGD:
 
         (m_steps, m_examples, m_loss,
          m_examples_per_sec) = self._train_metrics()
+        # per-worker windowed health, chunk-amortized (trainview.py)
+        thist = observe_trainview.get_train_history()
         # ONE feeder across passes, like the per-step pipelined loop
         feeder = DeviceFeeder(reader, self.topology, feeding=feeding,
                               depth=max(int(feed_depth), k),
@@ -703,6 +729,8 @@ class SGD:
                 if wall_ms > 0:
                     m_examples_per_sec.set(
                         chunk.examples / wall_ms * 1000.0)
+                thist.record_chunk(n, wall_ms, examples=chunk.examples,
+                                   feed_stall_ms=chunk.stall_ms)
                 if sentinel is not None:
                     # chunk granularity: ONE ring record per chunk; the
                     # per-loss checks run inside the per-step loop below,
@@ -1024,6 +1052,7 @@ class SGD:
                 if kick is not None:
                     kick()
         ms = (time.perf_counter() - t0) * 1e3
+        observe_trainview.get_train_history().record_checkpoint(ms)
         unpool = self._pool.unpool_state if self._pool is not None else None
         ctx["writer"].submit(ckpt.CheckpointSnapshot(
             values, self.parameters.copy(), step=self._step_count,
@@ -1045,12 +1074,18 @@ class SGD:
                                         keep=ctx["keep"],
                                         resume_at=(pass_id, cursor))
         ms = (time.perf_counter() - t0) * 1e3
+        observe_trainview.get_train_history().record_checkpoint(ms)
         if ctx["slog"] is not None:
             ctx["slog"].log_checkpoint(
                 step=self._step_count, duration_ms=ms,
                 nbytes=ckpt.checkpoint_bytes(path), overlapped=False,
                 step_thread_ms=ms, pass_id=pass_id,
                 path=os.path.basename(path))
+            # timeline mirror of the commit (observe/trainview.py)
+            ctx["slog"].log_elastic_event(
+                "checkpoint_commit",
+                worker=observe_trainview.worker_id(),
+                step=self._step_count, checkpoint=os.path.basename(path))
 
     def _checkpoint_close(self, ctx):
         """Drain + stop the writer; re-raises a writer error so a

@@ -1,0 +1,129 @@
+"""One elastic-fleet worker for the 2-worker kill -9 chaos gate
+(tests/test_preemption.py).
+
+Registers with the coordinator under ``--worker-id``, stamps that id
+into ``PADDLE_TPU_TRAIN_WORKER`` (exactly what distributed/worker.py
+does for a real launch) and the shared ``--telemetry-dir`` into
+``PADDLE_TPU_TELEMETRY``, then drives :func:`run_elastic` over a
+deterministic chunked dataset. Each worker writes per-worker steplogs
+(``train-t<i>`` / ``elastic-t<i>``) into the SHARED telemetry dir —
+the parent test SIGKILLs one worker and asserts the survivor's merged
+``cli observe`` report shows the ordered recovery timeline
+(worker_lost -> rewind -> re_deal -> resume).
+
+Prints one flushed line per finalized step::
+
+    LOSS <pass> <batch> <%.17g cost>
+
+and on completion::
+
+    DONE reforms=<n> lost=<ids-csv>
+"""
+
+import argparse
+import os
+import sys
+import zlib
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def build_trainer():
+    import paddle_tpu as paddle
+    from paddle_tpu import data_type as dt, layer as L
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.parameters import Parameters
+
+    # stable auto-names across processes: every fleet member must agree
+    # on parameter names for the shared checkpoint dir to be exchangeable
+    reset_name_counters()
+    x = L.data(name="x", type=dt.dense_vector(4))
+    lab = L.data(name="y", type=dt.integer_value(2))
+    cost = L.classification_cost(input=L.fc(input=x, size=2), label=lab)
+    params = Parameters.create(cost)
+    return paddle.trainer.SGD(
+        cost, params, opt.Momentum(momentum=0.9, learning_rate=0.1))
+
+
+def chunk_samples(chunk, batches_per_chunk, batch_size):
+    """Deterministic per-chunk data: a pure function of the chunk name,
+    so a re-dealt chunk yields IDENTICAL samples on whichever survivor
+    picks it up (crc32, NOT hash(): str hashing is salted per process
+    and the workers must agree)."""
+    rng = np.random.RandomState(zlib.crc32(chunk.encode()) % (2 ** 31))
+    W = np.random.RandomState(0).randn(4, 2)  # one shared concept
+    out = []
+    for _ in range(batches_per_chunk * batch_size):
+        x = rng.randn(4).astype(np.float32)
+        out.append((x, int(np.argmax(x @ W))))
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True, metavar="HOST:PORT")
+    ap.add_argument("--worker-id", required=True)
+    ap.add_argument("--checkpoint-dir", required=True)
+    ap.add_argument("--telemetry-dir", required=True)
+    ap.add_argument("--chunks", type=int, default=8)
+    ap.add_argument("--batches-per-chunk", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-passes", type=int, default=8)
+    ap.add_argument("--expected-workers", type=int, default=2)
+    ap.add_argument("--ttl", type=float, default=3.0)
+    ap.add_argument("--poll-secs", type=float, default=0.25)
+    ap.add_argument("--pace", type=float, default=0.1,
+                    help="sleep per step: keeps the run long enough for "
+                         "the parent's kill + the survivor's ttl-lapse "
+                         "detection window to land mid-training")
+    args = ap.parse_args(argv)
+
+    # the two wiring points a real launch gets from distributed/worker.py
+    # + the launcher env: worker identity and the shared telemetry dir
+    os.environ["PADDLE_TPU_TRAIN_WORKER"] = args.worker_id
+    os.environ["PADDLE_TPU_TELEMETRY"] = args.telemetry_dir
+
+    import paddle_tpu as paddle
+    from paddle_tpu import minibatch
+    from paddle_tpu.distributed import elastic
+
+    trainer = build_trainer()
+    chunks = ["chunk-%02d" % i for i in range(args.chunks)]
+
+    def reader_of(mine):
+        def samples():
+            for chunk in sorted(mine):
+                for s in chunk_samples(chunk, args.batches_per_chunk,
+                                       args.batch_size):
+                    yield s
+
+        return minibatch.batch(samples, args.batch_size)
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            print("LOSS %d %d %.17g" % (e.pass_id, e.batch_id, e.cost),
+                  flush=True)
+            if args.pace:
+                import time
+
+                time.sleep(args.pace)
+
+    stats = elastic.run_elastic(
+        trainer, args.coordinator, chunks, reader_of,
+        args.checkpoint_dir, num_passes=args.num_passes,
+        checkpoint_every=2, checkpoint_sync=True,
+        worker_id=args.worker_id, heartbeat_ttl=args.ttl,
+        poll_secs=args.poll_secs, event_handler=handler,
+        expected_workers=args.expected_workers)
+    print("DONE reforms=%d lost=%s"
+          % (stats["reforms"], ",".join(stats["lost"])), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -440,6 +440,21 @@ def _full_featured_log(tmp_path):
                             slow_burn=0.7, budget_remaining=0.3,
                             breaching_phase="queue_ms", worker="1",
                             model="mnist_mlp")
+        slog.log_anomaly(step=2, kind="cost_spike", cost=9.5,
+                         threshold=3.0, mode="warn", worker="trainer-0")
+        slog.log_crash_report(reason="anomaly:cost_spike",
+                              steps=[{"step": 2, "wall_ms": 3.0}],
+                              captured=1, capacity=64, mode="warn",
+                              worker="trainer-0")
+        slog.log_elastic_event("worker_lost", worker="trainer-0",
+                               members=["trainer-0"], lost=["trainer-1"],
+                               detail="lease expired")
+        slog.log_elastic_event("rewind", worker="trainer-0",
+                               members=["trainer-0"],
+                               checkpoint="pass-00000-step-00000002")
+        slog.log_elastic_event("checkpoint_commit", worker="trainer-0",
+                               step=2,
+                               checkpoint="pass-00000-step-00000002")
         slog.log_pass(0, metrics={"err": 0.25})
     return steplog.read_jsonl(os.path.join(str(tmp_path),
                                            "unit.steps.jsonl"))

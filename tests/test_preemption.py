@@ -29,6 +29,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHAOS = os.path.join(REPO, "tests", "fixtures", "chaos_train.py")
+ELASTIC = os.path.join(REPO, "tests", "fixtures", "elastic_train.py")
 
 
 # ---------------------------------------------------------------------------
@@ -296,3 +297,121 @@ def test_kill9_chaos_resume_identical_trajectory(tmp_path):
     meta_step = int(os.path.basename(latest).rsplit("-", 1)[1])
     first = min(res)
     assert first[0] * 10 + first[1] + 1 == meta_step + 1, (first, meta_step)
+
+
+# ---------------------------------------------------------------------------
+# 2-worker elastic kill -9: merged fleet timeline (subprocess; CPU; slow)
+# ---------------------------------------------------------------------------
+def _spawn_elastic(endpoint, wid, ckpt_dir, tel_dir, *extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_TPU_TELEMETRY", None)  # the fixture sets its own
+    env.pop("PADDLE_TPU_TRAIN_WORKER", None)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen(
+        [sys.executable, ELASTIC, "--coordinator", endpoint,
+         "--worker-id", wid, "--checkpoint-dir", ckpt_dir,
+         "--telemetry-dir", tel_dir] + list(extra),
+        stdout=subprocess.PIPE, env=env, cwd=REPO)
+
+
+def _wait_loss_lines(proc, want, timeout=120):
+    """Block until ``want`` LOSS lines arrived from the child."""
+    seen = 0
+    sel = selectors.DefaultSelector()
+    fd = proc.stdout.fileno()
+    sel.register(fd, selectors.EVENT_READ)
+    deadline = time.time() + timeout
+    buf = b""
+    try:
+        while seen < want and time.time() < deadline:
+            if not sel.select(timeout=max(0.0, deadline - time.time())):
+                break
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                break
+            buf += chunk
+            seen = buf.count(b"LOSS ")
+    finally:
+        sel.close()
+    assert seen >= want, "only %d/%d LOSS lines before timeout" % (seen,
+                                                                   want)
+
+
+@pytest.mark.slow
+def test_kill9_elastic_fleet_timeline(tmp_path, capsys):
+    """ISSUE 19 acceptance: kill -9 one of two elastic workers; the
+    survivor reforms and finishes, and the SHARED telemetry dir merges
+    into one ``cli observe`` report whose elastic timeline orders
+    worker_lost -> rewind -> re_deal -> resume with membership
+    snapshots consistent with the death (members == survivor only,
+    lost == the killed worker)."""
+    from paddle_tpu.distributed.client import spawn_coordinator_on_free_port
+    from paddle_tpu.observe import steplog
+
+    port, coord = spawn_coordinator_on_free_port()
+    endpoint = "127.0.0.1:%d" % port
+    ckpt_dir = str(tmp_path / "ck")
+    tel_dir = str(tmp_path / "telemetry")
+    w0 = w1 = None
+    try:
+        w0 = _spawn_elastic(endpoint, "trainer-0", ckpt_dir, tel_dir)
+        w1 = _spawn_elastic(endpoint, "trainer-1", ckpt_dir, tel_dir)
+        # kill once the victim demonstrably trained (the step-0 baseline
+        # checkpoint commits before the first step, so a rewind target
+        # exists from the start)
+        _wait_loss_lines(w1, 2)
+        os.kill(w1.pid, signal.SIGKILL)
+        t_kill = time.time()
+        w1.wait(timeout=30)
+        out, _ = w0.communicate(timeout=240)
+        assert w0.returncode == 0, out.decode(errors="replace")[-800:]
+        done = [ln for ln in out.decode().splitlines()
+                if ln.startswith("DONE")]
+        assert done and "reforms=1" in done[0] and "trainer-1" in done[0], \
+            done
+    finally:
+        for proc in (w1, w0):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        coord.kill()
+        coord.wait()
+
+    fleet = steplog.summarize_dir(tel_dir)["train_fleet"]
+    # both workers' steplogs pooled into the skew table (the victim's
+    # torn tail must not break the merge)
+    assert set(fleet["skew"]["workers"]) == {"trainer-0", "trainer-1"}
+    timeline = fleet["timeline"]
+    lost_idx = next(i for i, e in enumerate(timeline)
+                    if e["kind"] == "worker_lost")
+    lost_ev = timeline[lost_idx]
+    assert lost_ev["worker"] == "trainer-0"
+    assert lost_ev["lost"] == ["trainer-1"]
+    assert lost_ev["members"] == ["trainer-0"]
+    assert lost_ev["at"] >= t_kill - 1.0  # after the kill, absolute time
+    # the recovery reads in order AFTER the loss (checkpoint_commit /
+    # lease_renew_fail records may interleave; order among these four
+    # is the contract)
+    tail = timeline[lost_idx:]
+    want = ["worker_lost", "rewind", "re_deal", "resume"]
+    got = [e for e in tail if e["kind"] in want]
+    assert [e["kind"] for e in got] == want, [e["kind"] for e in tail]
+    for e in got:
+        assert e["members"] == ["trainer-0"], e
+    rewind = got[1]
+    assert rewind.get("checkpoint", "").startswith("pass-")
+    assert fleet["rewinds"] == 1
+    # the fleet must have trained as TWO workers before the death: the
+    # first deal's membership snapshot names both
+    first_deal = next(e for e in timeline if e["kind"] == "re_deal")
+    assert first_deal["members"] == ["trainer-0", "trainer-1"]
+
+    from paddle_tpu import cli
+
+    assert cli.main(["observe", tel_dir]) in (0, None)
+    rendered = capsys.readouterr().out
+    assert "training fleet: 2 worker(s)" in rendered
+    assert "elastic timeline:" in rendered
+    for kind in want:
+        assert kind in rendered

@@ -1,0 +1,279 @@
+"""Training-fleet observability plane tests (observe/trainview.py).
+
+Covers the docs/observability.md "Training-fleet view" contract: the
+``PADDLE_TPU_TRAIN_WORKER`` identity channel, the bounded per-worker
+TrainHealthHistory ring (record/snapshot/merge, O(1) memory), the
+cross-worker step-time skew + straggler detector, the absolute-time
+elastic event timeline assembly, and the ``summarize_dir`` /
+``cli observe`` aggregation over a synthetic 2-worker telemetry
+directory. The live 2-worker chaos path (kill -9 + reform + merged
+timeline) is pinned by tests/test_preemption.py.
+"""
+
+import json
+import os
+
+import pytest
+
+from paddle_tpu.observe import steplog, trainview
+
+
+# -- worker identity ---------------------------------------------------------
+
+def test_worker_identity_channel(monkeypatch):
+    monkeypatch.delenv(trainview.WORKER_ENV, raising=False)
+    assert trainview.worker_id() is None
+    assert trainview.worker_index() is None
+    assert trainview.worker_run_name("train") == "train"
+
+    monkeypatch.setenv(trainview.WORKER_ENV, "  ")
+    assert trainview.worker_id() is None  # blank == unset
+
+    monkeypatch.setenv(trainview.WORKER_ENV, "trainer-3")
+    assert trainview.worker_id() == "trainer-3"
+    assert trainview.worker_index() == 3
+    assert trainview.worker_run_name("train") == "train-t3"
+
+    # an id with no trailing index still gets a per-worker file name
+    assert trainview.worker_index("host/a") is None
+    assert trainview.worker_run_name("train", "host/a") == "train-thost_a"
+
+
+# -- TrainHealthHistory ------------------------------------------------------
+
+def test_history_records_both_loop_shapes():
+    h = trainview.TrainHealthHistory(window_s=1.0, horizon_s=10.0)
+    h.record_step(10.0, examples=32, feed_stall_ms=1.5, t=100.2)
+    h.record_step(30.0, examples=32, t=100.7)
+    # fused chunk: wall amortized over its real steps (per-step 5 ms)
+    h.record_chunk(4, 20.0, examples=128, t=101.1)
+    h.record_checkpoint(7.5, t=101.2)
+    snap = h.snapshot(now=101.9)
+    assert [w["epoch"] for w in snap["windows"]] == [100, 101]
+    first, second = snap["windows"]
+    assert first["steps"] == 2
+    assert first["step_ms_sum"] == pytest.approx(40.0)
+    assert first["step_ms_max"] == pytest.approx(30.0)
+    assert sorted(first["samples"]) == [10.0, 30.0]
+    assert first["examples"] == 64
+    assert first["feed_stall_ms"] == pytest.approx(1.5)
+    assert second["steps"] == 4 and second["chunks"] == 1
+    assert second["chunk_steps"] == 4
+    assert second["samples"] == [5.0]  # one reservoir entry per chunk
+    assert second["ckpts"] == 1
+    assert second["ckpt_ms"] == pytest.approx(7.5)
+    assert snap["totals"] == {"steps": 6, "examples": 192,
+                              "step_ms_sum": 60.0}
+
+
+def test_history_ring_is_bounded_and_reclaims():
+    h = trainview.TrainHealthHistory(window_s=1.0, horizon_s=4.0)
+    assert h.ring_len() == 4
+    for t in range(12):  # 3x the horizon
+        h.record_step(1.0, t=float(t))
+    snap = h.snapshot(now=11.0)
+    # only the live horizon survives; old epochs were reclaimed in place
+    assert [w["epoch"] for w in snap["windows"]] == [8, 9, 10, 11]
+    assert snap["totals"]["steps"] == 12  # totals stay monotone
+    # the sample reservoir never outgrows its cap
+    h2 = trainview.TrainHealthHistory(window_s=1.0, horizon_s=2.0,
+                                      samples_per_window=8)
+    for _ in range(100):
+        h2.record_step(2.0, t=0.5)
+    win = h2.snapshot(now=0.9)["windows"][0]
+    assert win["steps"] == 100 and len(win["samples"]) == 8
+
+
+def test_history_disable_and_reset():
+    h = trainview.TrainHealthHistory(window_s=1.0, horizon_s=5.0)
+    h.set_enabled(False)
+    assert h.enabled is False
+    h.record_step(5.0, t=1.0)
+    h.record_chunk(2, 5.0, t=1.0)
+    h.record_checkpoint(5.0, t=1.0)
+    assert h.snapshot(now=1.5)["windows"] == []
+    h.set_enabled(True)
+    h.record_step(5.0, t=2.0)
+    assert h.snapshot(now=2.5)["totals"]["steps"] == 1
+    h.reset()
+    snap = h.snapshot(now=2.5)
+    assert snap["windows"] == [] and snap["totals"]["steps"] == 0
+    with pytest.raises(ValueError):
+        trainview.TrainHealthHistory(window_s=2.0, horizon_s=1.0)
+
+
+def test_get_train_history_env_knobs(monkeypatch):
+    monkeypatch.setattr(trainview, "_global_history", None)
+    monkeypatch.setenv("PADDLE_TPU_HEALTH_WINDOW_S", "2.0")
+    monkeypatch.setenv("PADDLE_TPU_HEALTH_HORIZON_S", "20")
+    monkeypatch.setenv("PADDLE_TPU_HEALTH", "0")
+    h = trainview.get_train_history()
+    assert h is trainview.get_train_history()  # one per process
+    assert h.window_s == 2.0 and h.ring_len() == 10
+    assert h.enabled is False
+    trainview.set_enabled(True)  # the bench A/B switch
+    assert h.enabled is True
+    monkeypatch.setattr(trainview, "_global_history", None)
+
+
+def test_merge_train_history_folds_same_epoch_windows():
+    a = trainview.TrainHealthHistory(window_s=1.0, horizon_s=10.0)
+    b = trainview.TrainHealthHistory(window_s=1.0, horizon_s=10.0)
+    a.record_step(10.0, examples=8, t=100.1)
+    b.record_step(20.0, examples=8, t=100.6)  # same wall-clock epoch
+    b.record_checkpoint(3.0, t=101.0)
+    merged = trainview.merge_train_history(
+        [a.snapshot(now=101.5), b.snapshot(now=101.5)])
+    assert [w["epoch"] for w in merged["windows"]] == [100, 101]
+    fused = merged["windows"][0]
+    assert fused["steps"] == 2
+    assert fused["step_ms_max"] == pytest.approx(20.0)
+    assert sorted(fused["samples"]) == [10.0, 20.0]
+    assert merged["totals"]["steps"] == 2
+    assert merged["totals"]["examples"] == 16
+    empty = trainview.merge_train_history([])
+    assert empty["windows"] == [] and empty["totals"]["steps"] == 0
+
+
+# -- skew + straggler --------------------------------------------------------
+
+def test_step_time_skew_pools_the_fleet_median():
+    skew = trainview.step_time_skew({
+        "trainer-0": [10.0] * 10,
+        "trainer-1": [30.0] * 10,
+    })
+    # pooled median sits between the two clusters: (10 + 30) / 2
+    assert skew["fleet_median_ms"] == pytest.approx(20.0)
+    assert skew["workers"]["trainer-0"]["skew"] == pytest.approx(0.5)
+    assert skew["workers"]["trainer-1"]["skew"] == pytest.approx(1.5)
+    assert skew["workers"]["trainer-1"]["p95_ms"] == pytest.approx(30.0)
+    assert trainview.step_time_skew({}) is None
+    assert trainview.step_time_skew({"w": []}) is None
+
+
+def test_find_straggler_needs_a_fleet_and_a_threshold():
+    skew = trainview.step_time_skew({
+        "trainer-0": [10.0] * 10, "trainer-1": [30.0] * 10})
+    wid, value = trainview.find_straggler(skew)
+    assert wid == "trainer-1" and value == pytest.approx(1.5)
+    # below threshold: nobody is named
+    assert trainview.find_straggler(skew, threshold=2.0) is None
+    # a single worker has no one to straggle behind
+    solo = trainview.step_time_skew({"trainer-0": [10.0] * 10})
+    assert trainview.find_straggler(solo) is None
+    assert trainview.find_straggler(None) is None
+
+
+# -- elastic timeline --------------------------------------------------------
+
+def test_assemble_timeline_orders_across_files():
+    # two files whose RELATIVE t streams interleave only once each
+    # file's meta unix_time base is applied
+    ev_a = [(1000.0, {"kind": "worker_lost", "t": 5.0, "worker": "a"}),
+            (1000.0, {"kind": "rewind", "t": 5.5, "worker": "a"})]
+    ev_b = [(1003.0, {"kind": "register", "t": 0.0, "worker": "b"}),
+            (1003.0, {"kind": "resume", "t": 3.0, "worker": "b"})]
+    timeline = trainview.assemble_timeline(ev_a + ev_b)
+    assert [e["kind"] for e in timeline] == [
+        "register", "worker_lost", "rewind", "resume"]
+    assert [e["at"] for e in timeline] == [1003.0, 1005.0, 1005.5, 1006.0]
+    # ties order deterministically by worker id
+    tied = trainview.assemble_timeline(
+        [(0.0, {"kind": "register", "t": 1.0, "worker": "b"}),
+         (0.0, {"kind": "register", "t": 1.0, "worker": "a"})])
+    assert [e["worker"] for e in tied] == ["a", "b"]
+
+
+def test_fleet_summary_combines_skew_and_timeline():
+    workers = {
+        "trainer-0": {"walls": [10.0] * 10, "steps": 10, "examples": 320,
+                      "files": ["train-t0.steps.jsonl"]},
+        "trainer-1": {"walls": [30.0] * 10, "steps": 10, "examples": 320,
+                      "files": ["train-t1.steps.jsonl"]},
+    }
+    events = [(50.0, {"kind": "worker_lost", "t": 1.0, "worker": "a"}),
+              (50.0, {"kind": "rewind", "t": 2.0, "worker": "a"})]
+    out = trainview.fleet_summary(workers, events)
+    assert out["straggler"] == {"worker": "trainer-1", "skew": 1.5}
+    assert out["skew"]["workers"]["trainer-0"]["files"] == [
+        "train-t0.steps.jsonl"]
+    assert [e["kind"] for e in out["timeline"]] == ["worker_lost",
+                                                    "rewind"]
+    assert out["rewinds"] == 1
+    assert trainview.fleet_summary({}, []) is None
+    # the aggregation mirrors per-worker skew to the labeled gauge
+    from paddle_tpu.observe import metrics as observe_metrics
+
+    g = observe_metrics.get_registry().gauge(
+        "paddle_tpu_train_step_skew", labels={"worker": "trainer-1"})
+    assert g.value == pytest.approx(1.5)
+
+
+# -- summarize_dir + cli observe over a 2-worker directory -------------------
+
+def _fleet_dir(tmp_path):
+    """Synthetic shared telemetry dir: two train workers (one 3x
+    slower) plus an elastic-phase log carrying the recovery story."""
+    for wid, wall in (("trainer-0", 10.0), ("trainer-1", 30.0)):
+        name = trainview.worker_run_name("train", wid)
+        with steplog.StepLog(str(tmp_path), run_name=name,
+                             meta={"phase": "train", "worker": wid},
+                             compile_events=False) as slog:
+            for i in range(6):  # wall[0] is the compile-tail drop
+                slog.log_step(step=i + 1, wall_ms=wall, examples=16)
+            slog.log_elastic_event("checkpoint_commit", worker=wid,
+                                   step=6, checkpoint="pass-0-step-6")
+    with steplog.StepLog(str(tmp_path), run_name="elastic-t0",
+                         meta={"phase": "elastic", "worker": "trainer-0"},
+                         compile_events=False) as slog:
+        slog.log_elastic_event("register",
+                               members=["trainer-0", "trainer-1"],
+                               worker="trainer-0")
+        slog.log_elastic_event("worker_lost", members=["trainer-0"],
+                               lost=["trainer-1"], worker="trainer-0")
+        slog.log_elastic_event("rewind", members=["trainer-0"],
+                               checkpoint="pass-0-step-6",
+                               worker="trainer-0")
+        slog.log_elastic_event("re_deal", members=["trainer-0"],
+                               detail="4 of 8 shards", worker="trainer-0")
+        slog.log_elastic_event("resume", members=["trainer-0"],
+                               worker="trainer-0")
+
+
+def test_summarize_dir_builds_the_train_fleet_block(tmp_path):
+    _fleet_dir(tmp_path)
+    summary = steplog.summarize_dir(str(tmp_path))
+    fleet = summary["train_fleet"]
+    assert fleet["straggler"]["worker"] == "trainer-1"
+    workers = fleet["skew"]["workers"]
+    # per-file steady tail: 6 walls -> 5 pooled per worker
+    assert workers["trainer-0"]["steps"] == 6
+    assert workers["trainer-1"]["skew"] >= trainview.DEFAULT_SKEW_THRESHOLD
+    kinds = [e["kind"] for e in fleet["timeline"]]
+    # every file's events land in ONE timeline (2 commits + 5 elastic)
+    assert kinds.count("checkpoint_commit") == 2
+    for want in ("register", "worker_lost", "rewind", "re_deal",
+                 "resume"):
+        assert want in kinds
+    assert fleet["rewinds"] == 1
+    # the per-run rows keep their worker attribution
+    by_worker = {r.get("train_worker"): r for r in summary["runs"]
+                 if "train_worker" in r}
+    assert set(by_worker) == {"trainer-0", "trainer-1"}
+    # train workers must NOT leak into the serving-fleet pooling
+    assert not any("serve_worker" in r for r in summary["runs"])
+
+
+def test_cli_observe_renders_fleet_and_timeline(tmp_path, capsys):
+    _fleet_dir(tmp_path)
+    from paddle_tpu import cli
+
+    assert cli.main(["observe", str(tmp_path)]) in (0, None)
+    out = capsys.readouterr().out
+    assert "training fleet: 2 worker(s)" in out
+    assert "straggler: trainer-1" in out
+    assert "elastic timeline: 7 event(s)" in out
+    assert "worker_lost" in out and "rewind" in out
+    assert cli.main(["observe", str(tmp_path), "--json"]) in (0, None)
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["train_fleet"]["straggler"]["worker"] == "trainer-1"
