@@ -106,6 +106,8 @@ Usage:
 import argparse
 import json
 import os
+import signal
+import subprocess
 import sys
 import tempfile
 import threading
@@ -1075,6 +1077,223 @@ def measure_sessions(args):
     return [row_a, row_b]
 
 
+def measure_hosts_ab(args):
+    """The multi-host serving acceptance drill (docs/serving.md
+    "Multi-host serving"): a 2-host MULTI-PROCESS fleet — ``cli serve
+    --join`` OS processes behind the coordinator, all paging against
+    ONE shared remote-store process — driven through the fleet-of-
+    fleets front with a fixed-seed think-time session trace, then one
+    host SIGKILLed mid-conversation (between committed chunks: every
+    acked chunk was spilled to the shared store before its reply, so
+    the kill lands in think-time where the only session state is the
+    committed one). Gates asserted BEFORE any row emits:
+
+    1. zero committed sessions lost — EVERY conversation's
+       concatenated pre+post-kill outputs equal the in-process
+       whole-sequence decode BITWISE (float32 survives the JSON hop
+       exactly), and no session errors in any phase;
+    2. chaos p99 < ``--hosts-p99-factor`` x the steady-state p99 — the
+       rehome penalty is a bounded blip, not a stall;
+    3. zero post-warmup compiles on the survivors across the chaos
+       window (``GET /debug/compiles`` diff) — re-homed sessions
+       restore into already-compiled programs.
+    """
+    import concurrent.futures
+
+    from paddle_tpu.distributed.client import (
+        CoordinatorClient, spawn_coordinator_on_free_port)
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler, load_bundle
+    from paddle_tpu.serve.cluster import ClusterFront
+
+    bundle_dir = args.bundle or _export_tagger_bundle(
+        tempfile.mkdtemp(prefix="serve_tagger_"),
+        tuple(int(b) for b in args.batch_sizes.split(",")),
+        args.seq_len, args.decode_slots, args.decode_window, args.hidden)
+    bundle = load_bundle(bundle_dir)
+    in_name = bundle.inputs[0]["name"]
+    out_name = bundle.outputs[0]["name"]
+    sessions = args.hosts_sessions
+    n_hosts = args.serve_hosts
+    assert n_hosts >= 2, "--mode hosts-ab needs >= 2 hosts to kill one"
+    assert args.chunks_per_session >= 2, (
+        "--mode hosts-ab kills MID-conversation: need >= 2 chunks")
+    starts, chunks, thinks = session_trace(
+        sessions, args.chunks_per_session, args.mean_len,
+        args.think_ms, args.session_ramp_s, args.seed)
+
+    # the bitwise reference: each conversation decoded whole, in one
+    # process — what the cluster must reproduce across the kill
+    ref = ContinuousScheduler(bundle, metrics_registry=MetricsRegistry(),
+                              model="tagger_ref", max_queue=None)
+    whole = {i: ref.infer({in_name: np.concatenate(chunks[i])},
+                          timeout=600.0)[out_name]
+             for i in range(sessions)}
+    ref.stop()
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONUNBUFFERED="1",
+               PYTHONPATH=(repo_root + os.pathsep
+                           + os.environ.get("PYTHONPATH", "")))
+    env.pop("PADDLE_TPU_TELEMETRY", None)  # hosts log to their own runs
+    port, coord = spawn_coordinator_on_free_port()
+    endpoint = "127.0.0.1:%d" % port
+    store = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serve.remote_store",
+         "--port", "0", "--capacity", str(args.session_store)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    procs, front, pool = {}, None, None
+    try:
+        line = store.stdout.readline().strip()
+        assert line.startswith("listening "), (
+            "remote store failed to start: %r" % line)
+        store_addr = line.split()[-1]
+        for i in range(n_hosts):
+            hid = "h%d" % i
+            procs[hid] = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.cli", "serve",
+                 bundle_dir, "--continuous", "--port", "0",
+                 "--join", endpoint, "--host-id", hid,
+                 "--lease-ttl", "5",
+                 "--session-store-addr", store_addr],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env)
+        client = CoordinatorClient(endpoint, worker_id="hosts_ab",
+                                   retry_timeout=5.0)
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline:
+            if len(client.serve_hosts()["hosts"]) == n_hosts:
+                break
+            for hid, p in procs.items():
+                assert p.poll() is None, "host %s died at startup" % hid
+            time.sleep(0.5)
+        else:
+            raise AssertionError("hosts never joined the coordinator")
+        client.close()
+        front = ClusterFront(endpoint=endpoint, poll_interval=0.2,
+                             metrics_registry=MetricsRegistry(),
+                             host_timeout=10.0, request_timeout=60.0)
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline and not front.ready():
+            time.sleep(0.5)
+        assert front.ready(), "hosts never warmed"
+
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(sessions, 4),
+            thread_name_prefix="hosts-ab-client")
+
+        def submit(i, chunk, end):
+            return pool.submit(front.infer, {in_name: chunk},
+                               timeout=120.0, session_id="s%d" % i,
+                               end_session=end)
+
+        # steady phase: the FIRST half of every conversation, fleet
+        # intact — its latencies are the p99 baseline, and every acked
+        # chunk is committed to the shared store before its reply
+        mid = max(1, args.chunks_per_session // 2)
+        pre = [c[:mid] for c in chunks]
+        post = [c[mid:] for c in chunks]
+        pre_thinks = [t[:mid - 1] for t in thinks]
+        post_thinks = [t[mid:] for t in thinks]
+        lat_steady, _, outs_pre, failed_pre = drive_session_trace(
+            lambda i, c, last: submit(i, c, False),
+            starts, pre, pre_thinks)
+        assert failed_pre == 0, (
+            "steady phase failed %d sessions" % failed_pre)
+
+        # kill the host holding the most conversations, in think-time
+        # (no chunk in flight: the steady trace drained) — the drill's
+        # whole point is that committed carries outlive their host
+        homes = {i: front._session_last.get("s%d" % i)
+                 for i in range(sessions)}
+        by_host = {}
+        for i, h in homes.items():
+            by_host.setdefault(h, []).append(i)
+        victim = max(sorted(by_host), key=lambda h: len(by_host[h]))
+        hosts_map, _ = front._snapshot()
+        compiles_before = {
+            hid: e.host.compiles() for hid, e in hosts_map.items()
+            if hid != victim and e.live}
+        os.kill(procs[victim].pid, signal.SIGKILL)
+        procs[victim].wait(timeout=60)
+
+        # chaos phase: the SECOND half of every conversation — the
+        # victim's sessions re-home onto survivors from the store
+        lat_chaos, _, outs_post, failed_chaos = drive_session_trace(
+            lambda i, c, last: submit(i, c, last),
+            starts, post, post_thinks)
+        assert failed_chaos == 0, (
+            "chaos phase failed %d sessions — committed sessions were "
+            "lost with the host" % failed_chaos)
+        compiles_after = {
+            hid: e.host.compiles()
+            for hid, e in front._snapshot()[0].items()
+            if hid in compiles_before and e.live}
+        stats = front.stats()
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=False)
+        if front is not None:
+            front.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        store.terminate()
+        store.wait(timeout=10)
+        coord.terminate()
+        coord.wait(timeout=10)
+
+    # gate 1: zero committed sessions lost, bitwise
+    for i in range(sessions):
+        got = np.concatenate(outs_pre[i] + outs_post[i], axis=0)
+        assert got.shape == whole[i].shape and np.array_equal(
+            got, whole[i]), (
+            "session s%d diverges after the kill: the cluster lost "
+            "committed state" % i)
+    assert stats["session_rehomes"] >= 1, (
+        "the kill re-homed nothing — the drill did not exercise "
+        "failover (victim %r held %d sessions)"
+        % (victim, len(by_host.get(victim, ()))))
+    # gate 2: the rehome penalty is bounded
+    p50_s, p99_s = _percentiles(lat_steady)
+    p50_c, p99_c = _percentiles(lat_chaos)
+    factor = p99_c / max(p99_s, 1e-9)
+    assert factor < args.hosts_p99_factor, (
+        "chaos p99 %.1f ms is %.2fx steady p99 %.1f ms (gate %.1fx): "
+        "failover stalls the fleet" % (p99_c, factor, p99_s,
+                                       args.hosts_p99_factor))
+    # gate 3: survivors minted zero compiles across the chaos window
+    assert compiles_after == compiles_before, (
+        "chaos window minted compiles on survivors: %r -> %r"
+        % (compiles_before, compiles_after))
+
+    base = {
+        "unit": "ms", "sessions": sessions,
+        "chunks_per_session": args.chunks_per_session,
+        "think_ms": args.think_ms, "mean_len": args.mean_len,
+        "seq_len": bundle.seq_len, "seed": args.seed,
+        "hidden": args.hidden, "slots": args.decode_slots,
+        "window": args.decode_window, "transport": "http_json",
+        "store": "remote_process",
+    }
+    row_steady = dict(base, metric="serve_cluster_steady_p99_ms",
+                      value=p99_s, p50_ms=p50_s, p99_ms=p99_s,
+                      mode="hosts_steady", hosts=n_hosts)
+    row_chaos = dict(base, metric="serve_cluster_chaos_p99_ms",
+                     value=p99_c, p50_ms=p50_c, p99_ms=p99_c,
+                     mode="hosts_chaos", hosts=n_hosts - 1,
+                     session_rehomes=stats["session_rehomes"],
+                     p99_vs_steady=round(factor, 2),
+                     gate_p99_factor=args.hosts_p99_factor,
+                     committed_sessions_lost=0, serve_compiles=0)
+    return [row_steady, row_chaos]
+
+
 def measure_trace_overhead(args):
     """The tracing-overhead A/B: identical engines over one bundle,
     tracing off vs sampling at ``--trace-sample``, driven by the shared
@@ -1634,7 +1853,7 @@ def main(argv=None):
                     choices=("closed", "openloop-ab", "priority",
                              "replicas-ab", "workers-ab", "quant-ab",
                              "sessions", "trace-overhead",
-                             "health-overhead", "slo-ab"))
+                             "health-overhead", "slo-ab", "hosts-ab"))
     ap.add_argument("--bundle", default="",
                     help="pre-exported bundle dir (default: export the "
                          "mode's demo bundle to a tmp dir)")
@@ -1789,6 +2008,16 @@ def main(argv=None):
     ap.add_argument("--slo-tol-pct", type=float, default=10.0,
                     help="slo-ab gate: converged side must land within "
                          "this %% of hand-tuned sustained qps AND p99")
+    ap.add_argument("--serve-hosts", type=int, default=2,
+                    help="hosts-ab: subprocess serving hosts to join "
+                         "the fleet (one gets SIGKILLed mid-trace)")
+    ap.add_argument("--hosts-sessions", type=int, default=8,
+                    help="hosts-ab: concurrent conversations in the "
+                         "chaos trace (kept small: every chunk commits "
+                         "to the remote store over HTTP)")
+    ap.add_argument("--hosts-p99-factor", type=float, default=2.0,
+                    help="hosts-ab gate: chaos-phase p99 must stay "
+                         "under this multiple of the steady-state p99")
     args = ap.parse_args(argv)
     if args.hardcap_queue is None:
         args.hardcap_queue = 2 * args.decode_slots
@@ -1814,6 +2043,8 @@ def main(argv=None):
         return _emit(measure_health_overhead(args), "exp_serve_health")
     if args.mode == "slo-ab":
         return _emit(measure_slo_ab(args), "exp_serve_slo")
+    if args.mode == "hosts-ab":
+        return _emit(measure_hosts_ab(args), "exp_serve_hosts")
     bundle_dir = args.bundle
     if not bundle_dir:
         bundle_dir = _export_demo_bundle(

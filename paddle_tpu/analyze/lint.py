@@ -129,6 +129,16 @@ HOT_PATHS = {
                          "_eligible", "_route_session", "_rx_loop",
                          "_dispatch_response", "queue_depth",
                          "_op_traces", "_op_history"},
+    # the fleet-of-fleets front: dispatch walks the ring and relays
+    # frames per request, and the membership snapshot sits inside that
+    # walk — a host sync here stalls every cross-host request
+    "serve/cluster.py": {"dispatch_payload", "_candidates", "_snapshot",
+                         "_note_landing", "infer"},
+    # the remote session store: every spill/restore of every host in
+    # the cluster crosses these (client _call, server _dispatch) — the
+    # cluster-wide page-file hot path
+    "serve/remote_store.py": {"put", "pop", "gone_reason", "_call",
+                              "_dispatch"},
     # request-scoped tracing rides every serving submit/retire: the
     # sampler and the exemplar reservoir must never sync with a device
     "observe/tracing.py": {"resolve", "sample", "offer"},

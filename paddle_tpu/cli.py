@@ -357,16 +357,25 @@ def cmd_export(args):
 def _session_kwargs(args):
     """Session-tier knobs of ``cli serve --continuous``
     (docs/serving.md "Session tier & paging" knob table)."""
-    return {
+    kw = {
         "session_capacity": getattr(args, "session_store", 4096),
         "idle_spill_ms": getattr(args, "idle_spill_ms", None),
         "session_slo_grace_ms": getattr(args, "session_slo_ms", None),
         "session_ttl_ms": getattr(args, "session_ttl_ms", None),
     }
+    addr = getattr(args, "session_store_addr", "") or ""
+    if addr:
+        # multi-host session tier: every scheduler on this host pages
+        # against the SHARED store process instead of a private dict —
+        # committed sessions then survive this host (serve/remote_store)
+        from paddle_tpu.serve.remote_store import RemoteSessionStore
+
+        kw["session_store"] = RemoteSessionStore(addr)
+    return kw
 
 
 def _make_engine(bundle, args, reg, model=None, warmup="async",
-                 budget_share=None):
+                 budget_share=None, steplog=None):
     from paddle_tpu.serve import ContinuousScheduler, InferenceEngine
 
     if args.continuous and not bundle.has_decoder():
@@ -403,6 +412,14 @@ def _make_engine(bundle, args, reg, model=None, warmup="async",
                   else {"max_batch_size": args.max_batch_size,
                         "max_latency_ms": args.max_latency_ms,
                         "max_queue_rows": args.max_queue_rows})
+        if kwargs.get("session_store") is not None:
+            # a store CLIENT holds a live socket — it cannot cross the
+            # worker-process spawn boundary; each worker would need its
+            # own dial-up, which the worker protocol does not carry
+            print("--session-store-addr cannot combine with --workers: "
+                  "use --replicas or a single engine per host",
+                  file=sys.stderr)
+            raise SystemExit(2)
         return WorkerSet(bundle, workers=max(n, 1),
                          continuous=args.continuous,
                          engine_kwargs=kwargs, metrics_registry=reg,
@@ -434,11 +451,12 @@ def _make_engine(bundle, args, reg, model=None, warmup="async",
     if args.continuous:
         return ContinuousScheduler(
             bundle, warmup=warmup, metrics_registry=reg, model=model,
-            max_queue=args.max_queue_rows, **_session_kwargs(args))
+            max_queue=args.max_queue_rows, steplog=steplog,
+            **_session_kwargs(args))
     return InferenceEngine(
         bundle, max_batch_size=args.max_batch_size,
         max_latency_ms=args.max_latency_ms, warmup=warmup,
-        metrics_registry=reg, model=model,
+        metrics_registry=reg, model=model, steplog=steplog,
         max_queue_rows=args.max_queue_rows)
 
 
@@ -535,6 +553,49 @@ def cmd_serve(args):
     except ValueError:
         pass  # not the main thread (embedded callers): keep the default
 
+    join = getattr(args, "join", "") or ""
+    if getattr(args, "front", False):
+        # fleet-of-fleets front (docs/serving.md "Multi-host serving"):
+        # no bundle, no device — membership from the coordinator's TTL
+        # leases, a consistent-hash ring over the live hosts, session
+        # affinity with rehome-on-lease-lapse
+        if args.bundle or args.model or args.selfcheck:
+            print("--front holds no engine: drop the positional "
+                  "bundle / --model / --selfcheck", file=sys.stderr)
+            return 2
+        if not join:
+            print("--front needs --join COORD:PORT to discover hosts",
+                  file=sys.stderr)
+            return 2
+        from paddle_tpu.observe import steplog as observe_steplog
+        from paddle_tpu.serve.cluster import (ClusterFront,
+                                              make_front_server)
+
+        slog = observe_steplog.from_env(
+            "serve-front", meta={"phase": "serve_front"})
+        front = ClusterFront(endpoint=join, steplog=slog,
+                             rehome_retries=args.rehome_retries)
+        server = make_front_server(front, host=args.host,
+                                   port=args.port)
+        print("serving front on http://%s:%d over coordinator %s "
+              "(POST /infer; GET /healthz /readyz /hosts /stats "
+              "/metrics)" % (*server.server_address, join))
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.shutdown()
+            front.stop()
+            if slog is not None:
+                slog.close()
+        return 0
+    if join and args.model:
+        print("--join serves ONE bundle per host: the cluster front "
+              "routes bare POST /infer, not per-model paths",
+              file=sys.stderr)
+        return 2
+
     if args.model:
         if args.bundle or args.selfcheck:
             print("--model is multi-model mode: drop the positional "
@@ -596,12 +657,28 @@ def cmd_serve(args):
               file=sys.stderr)
         return 2
     bundle = load_bundle(args.bundle)
+    host_slog = None
+    host_id = ""
+    if join and not args.selfcheck:
+        import socket as _socket
+
+        from paddle_tpu.observe import steplog as observe_steplog
+
+        # one steplog per HOST, run-named "<run>@<host_id>" with the
+        # host in the meta line: cli observe merges the per-host files
+        # back into one cross-host timeline keyed on that suffix
+        host_id = (getattr(args, "host_id", "") or
+                   "%s-%d" % (_socket.gethostname(), os.getpid()))
+        host_slog = observe_steplog.from_env(
+            "serve@%s" % host_id,
+            meta={"phase": "serve", "host": host_id})
     # serving path: warm asynchronously so the HTTP endpoints bind
     # immediately and the readiness probe (/healthz, /readyz) honestly
     # reports ready=false until every bucket is warm; selfcheck warms
     # synchronously — it IS the warmth gate
     engine = _make_engine(bundle, args, observe_metrics.get_registry(),
-                          warmup=(True if args.selfcheck else "async"))
+                          warmup=(True if args.selfcheck else "async"),
+                          steplog=host_slog)
     if args.selfcheck:
         try:
             if hasattr(engine, "wait_ready"):
@@ -618,26 +695,61 @@ def cmd_serve(args):
             return 0
         finally:
             engine.stop()
+    import contextlib
+
     from paddle_tpu.serve.server import make_server
 
     slo = _make_slo([engine], args, model=bundle.name)
     controller = _make_controller(slo, [engine], args, model=bundle.name)
-    server = make_server(bundle, engine, host=args.host, port=args.port,
-                         slo=slo, controller=controller)
-    print("serving %r on http://%s:%d (POST /infer; GET /healthz "
-          "/readyz /metrics /stats /debug/slo%s /manifest)"
-          % (bundle.name, *server.server_address,
-             " /debug/control" if controller else ""))
-    try:
-        server.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    finally:
-        server.shutdown()
-        if controller is not None:
-            controller.stop()
-        slo.stop(close_slog=True)
-        engine.stop()
+    heartbeat = None
+    with contextlib.ExitStack() as stack:
+        compiles_fn = None
+        if join:
+            # post-warmup compile counter behind GET /debug/compiles:
+            # the hosts-ab bench diffs it across the chaos window to
+            # prove re-homed sessions resume without recompiling
+            from paddle_tpu.observe import steplog as observe_steplog
+
+            watcher = stack.enter_context(
+                observe_steplog.watch_compiles())
+            compiles_fn = (lambda: watcher.compiles)
+        server = make_server(bundle, engine, host=args.host,
+                             port=args.port, slo=slo,
+                             controller=controller,
+                             compiles_fn=compiles_fn)
+        if join:
+            from paddle_tpu.distributed.client import encode_host_meta
+            from paddle_tpu.distributed.elastic import HeartbeatThread
+
+            # start the lease only AFTER the server bound: the address
+            # announced through the lease meta must already answer —
+            # the front dials it the moment the host appears
+            heartbeat = HeartbeatThread(
+                join, worker_id=host_id, ttl=args.lease_ttl,
+                steplog=host_slog,
+                meta=encode_host_meta(
+                    kind="serve",
+                    addr="%s:%d" % server.server_address))
+            heartbeat.start()
+        print("serving %r on http://%s:%d (POST /infer; GET /healthz "
+              "/readyz /metrics /stats /debug/slo%s /manifest)%s"
+              % (bundle.name, *server.server_address,
+                 " /debug/control" if controller else "",
+                 (" joined %s as %r" % (join, host_id)) if join else ""))
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.shutdown()
+            if heartbeat is not None:
+                heartbeat.stop()
+            if controller is not None:
+                controller.stop()
+            slo.stop(close_slog=True)
+            engine.stop()
+            if host_slog is not None:
+                host_slog.close()
     return 0
 
 
@@ -698,6 +810,9 @@ def cmd_observe(args):
                                    retry_timeout=5.0)
         try:
             summary["fleet_stats"] = client.fleet_stats()
+            # serving hosts (workers registered WITH lease meta) next
+            # to the trainer leases: the same coordinator carries both
+            summary["serve_hosts_live"] = client.serve_hosts()
         finally:
             client.close()
     if args.json:
@@ -816,6 +931,46 @@ def cmd_observe(args):
             for widx, w in sorted(fleet["workers"].items(),
                                   key=lambda kv: int(kv[0])))
         print("    per-worker: %s" % breakdown)
+    for cluster in summary.get("serve_clusters", ()):
+        # cluster-merged tail attribution across per-HOST steplog files
+        # (run names "<run>@<host>"): each host's own p99 is blind to
+        # the cluster's true tail — pool before the percentile
+        tail = cluster["serve_tail"]
+        shares = "  ".join(
+            "%s %.1f%%" % (k[:-len("_ms")] if k.endswith("_ms") else k,
+                           v)
+            for k, v in sorted(tail["phases"].items(),
+                               key=lambda kv: -kv[1]))
+        print("  cluster %s merged tail attribution (p%g >= %.1f ms, "
+              "%d of %d traced across %d hosts): %s"
+              % (cluster["run"], tail["q"], tail["threshold_ms"],
+                 tail["tail_requests"], tail["requests"],
+                 len(cluster["hosts"]), shares))
+        breakdown = "  ".join(
+            "%s p99 %s (%d traced)"
+            % (hid, ("%.1f ms" % h["p99_ms"]) if "p99_ms" in h
+               else "n/a", h["traces"])
+            for hid, h in sorted(cluster["hosts"].items()))
+        print("    per-host: %s" % breakdown)
+    sh = summary.get("serve_hosts")
+    if sh:
+        # the serving-host membership timeline — the serving twin of
+        # the elastic timeline below, on the same absolute time axis
+        print("  serving hosts timeline: %d event(s), %d session "
+              "rehome(s)" % (len(sh["events"]), sh["rehomes"]))
+        for e in sh["events"]:
+            extras = []
+            if e.get("hosts") is not None:
+                extras.append("hosts=[%s]" % ",".join(e["hosts"]))
+            if e.get("session"):
+                extras.append("session=%s" % e["session"])
+            if e.get("target"):
+                extras.append("target=%s" % e["target"])
+            if e.get("detail"):
+                extras.append("(%s)" % e["detail"])
+            print("    at=%.3f %-16s host=%-16s %s"
+                  % (e["t_abs"], e["kind"], e.get("host", "-"),
+                     "  ".join(extras)))
     tf = summary.get("train_fleet")
     if tf:
         # the training-fleet block (observe/trainview.py): per-worker
@@ -871,6 +1026,13 @@ def cmd_observe(args):
         for w in ws:
             print("    %-12s lease remaining %.1fs"
                   % (w["id"], w["lease_remaining"]))
+        hosts = summary.get("serve_hosts_live", {}).get("hosts", [])
+        if hosts:
+            print("  serving hosts: %d" % len(hosts))
+            for h in hosts:
+                print("    %-12s lease remaining %.1fs  %s"
+                      % (h["id"], h["lease_remaining"],
+                         h.get("meta", "")))
     if summary["trace_files"]:
         print("  traces (open in https://ui.perfetto.dev): %s"
               % ", ".join(summary["trace_files"]))
@@ -1246,6 +1408,39 @@ def main(argv=None):
     p.add_argument("--session-ttl-ms", type=float, default=None,
                    help="session tier: evict suspended sessions idle "
                         "past this TTL (reason=ttl)")
+    p.add_argument("--join", default="", metavar="COORD:PORT",
+                   help="multi-host serving (docs/serving.md 'Multi-"
+                        "host serving'): register this host with the "
+                        "coordinator under a TTL heartbeat lease and "
+                        "publish its dial address through the lease "
+                        "meta; a front started with --front routes to "
+                        "it while the lease holds")
+    p.add_argument("--host-id", default="",
+                   help="--join: stable host identity on the hash "
+                        "ring (default hostname-pid); keep it stable "
+                        "across restarts so a rejoining host reclaims "
+                        "its ring arcs")
+    p.add_argument("--lease-ttl", type=float, default=10.0,
+                   help="--join: coordinator lease TTL in seconds — "
+                        "the failure-detection horizon; a host silent "
+                        "this long is excluded from routing")
+    p.add_argument("--session-store-addr", default="",
+                   metavar="HOST:PORT",
+                   help="--continuous: back the session tier with the "
+                        "standalone remote store process (python -m "
+                        "paddle_tpu.serve.remote_store) instead of a "
+                        "process-local store, so committed sessions "
+                        "survive host death and re-home bitwise")
+    p.add_argument("--front", action="store_true",
+                   help="run the fleet-of-fleets front instead of an "
+                        "engine: no bundle, no device — only sockets, "
+                        "the consistent-hash ring over the hosts "
+                        "joined via --join's coordinator, and routing "
+                        "state (session affinity, rehome on lease "
+                        "lapse, shed reason no_host)")
+    p.add_argument("--rehome-retries", type=int, default=2,
+                   help="--front: extra hosts tried after the ring "
+                        "home fails before the request errors out")
     p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)

@@ -87,6 +87,30 @@ def spawn_coordinator_on_free_port(snapshot_path="", task_timeout=600.0,
     raise last_err
 
 
+def encode_host_meta(**fields):
+    """Flat ``k=v,k=v`` metadata string for :meth:`CoordinatorClient
+    .register` — deliberately quote-free so it passes through the
+    coordinator's flat JSON parser verbatim (no nested-object support
+    there, by design)."""
+    for key, value in fields.items():
+        if any(c in "=,\"" for c in "%s%s" % (key, value)):
+            raise ValueError("host meta fields must not contain '=', "
+                             "',' or quotes: %r=%r" % (key, value))
+    return ",".join("%s=%s" % (k, v) for k, v in sorted(fields.items()))
+
+
+def decode_host_meta(meta):
+    """Inverse of :func:`encode_host_meta`; tolerant of junk (a field
+    without ``=`` is skipped) so one bad host cannot wedge the front's
+    membership poll."""
+    out = {}
+    for part in (meta or "").split(","):
+        key, eq, value = part.partition("=")
+        if eq:
+            out[key.strip()] = value.strip()
+    return out
+
+
 class CoordinatorClient:
     """One worker's RPC handle. NOT thread-safe (one socket + read
     buffer): a background thread (e.g. elastic.HeartbeatThread) must own
@@ -183,10 +207,19 @@ class CoordinatorClient:
     def task_failed(self, task_id):
         return self.call("task_failed", task_id=task_id)
 
-    def register(self, ttl=30.0):
+    def register(self, ttl=30.0, meta=None):
+        """``meta=`` is an optional flat metadata string attached to
+        this worker's membership entry (serving hosts announce their
+        dial address: ``"kind=serve,addr=HOST:PORT"``, see
+        :func:`encode_host_meta`); the coordinator republishes it via
+        the ``serve_hosts`` verb and drops it with the lease."""
+        if meta:
+            return self.call("register", ttl=ttl, meta=meta)
         return self.call("register", ttl=ttl)
 
-    def heartbeat(self, ttl=30.0):
+    def heartbeat(self, ttl=30.0, meta=None):
+        if meta:
+            return self.call("heartbeat", ttl=ttl, meta=meta)
         return self.call("heartbeat", ttl=ttl)
 
     def workers(self):
@@ -199,6 +232,14 @@ class CoordinatorClient:
         --fleet-stats`` (negative lease_remaining = lapsed, not yet
         swept)."""
         return self.call("fleet_stats")
+
+    def serve_hosts(self):
+        """Serving-host membership — the workers registered WITH
+        metadata (``cli serve --join``): ``{"now": ..., "hosts":
+        [{"id", "lease_remaining", "meta"}, ...]}``. Trainers (no
+        metadata) are excluded; the fleet-of-fleets front polls this
+        to build its routing ring (serve/cluster.py)."""
+        return self.call("serve_hosts")
 
     def request_save_model(self, ttl=60.0):
         """True iff this worker wins the save election (exactly one does

@@ -224,6 +224,7 @@ class Service {
     if (op == "register") return register_worker(req);
     if (op == "workers") return list_workers();
     if (op == "fleet_stats") return fleet_stats();
+    if (op == "serve_hosts") return serve_hosts();
     if (op == "request_save_model") return request_save_model(req);
     if (op == "status") return status();
     if (op == "snapshot") { snapshot(); return R"({"ok": true})"; }
@@ -246,9 +247,15 @@ class Service {
         ++it;
       }
     }
-    // expire worker leases
+    // expire worker leases (and their serving metadata with them: a
+    // lapsed lease IS the death signal the serving front keys off)
     for (auto it = workers_.begin(); it != workers_.end();) {
-      if (it->second < t) it = workers_.erase(it); else ++it;
+      if (it->second < t) {
+        meta_.erase(it->first);
+        it = workers_.erase(it);
+      } else {
+        ++it;
+      }
     }
     if (dirty_) { snapshot(); dirty_ = false; }
   }
@@ -352,6 +359,11 @@ class Service {
   std::string register_worker(std::map<std::string, JsonValue>& req) {
     double ttl = req.count("ttl") ? req["ttl"].num : 30.0;
     workers_[req["worker"].str] = now_sec() + ttl;
+    // optional flat metadata string (serving hosts announce their
+    // dial address here, "kind=serve,addr=HOST:PORT"); re-sent on
+    // every heartbeat so a coordinator restart re-learns it
+    if (req.count("meta") && !req["meta"].str.empty())
+      meta_[req["worker"].str] = req["meta"].str;
     std::ostringstream os;
     os << "{\"ok\": true, \"num_workers\": " << workers_.size() << "}";
     return os.str();
@@ -388,7 +400,34 @@ class Service {
     for (auto& kv : workers_) {
       if (!first) os << ", ";
       os << "{\"id\": \"" << json_escape(kv.first)
-         << "\", \"lease_remaining\": " << (kv.second - t) << "}";
+         << "\", \"lease_remaining\": " << (kv.second - t);
+      auto m = meta_.find(kv.first);
+      if (m != meta_.end())
+        os << ", \"meta\": \"" << json_escape(m->second) << "\"";
+      os << "}";
+      first = false;
+    }
+    os << "]}";
+    return os.str();
+  }
+
+  std::string serve_hosts() {
+    // serving-host membership: the workers that registered with
+    // metadata (cli serve --join) — what the fleet-of-fleets front
+    // polls to build its routing ring (serve/cluster.py). Same lease
+    // semantics as fleet_stats; hosts without metadata (trainers)
+    // are excluded.
+    double t = now_sec();
+    std::ostringstream os;
+    os << "{\"ok\": true, \"now\": " << t << ", \"hosts\": [";
+    bool first = true;
+    for (auto& kv : workers_) {
+      auto m = meta_.find(kv.first);
+      if (m == meta_.end()) continue;
+      if (!first) os << ", ";
+      os << "{\"id\": \"" << json_escape(kv.first)
+         << "\", \"lease_remaining\": " << (kv.second - t)
+         << ", \"meta\": \"" << json_escape(m->second) << "\"}";
       first = false;
     }
     os << "]}";
@@ -582,6 +621,7 @@ class Service {
   std::deque<Task> todo_, done_, failed_;
   std::map<int64_t, Task> pending_;
   std::map<std::string, double> workers_;  // worker -> lease expiry
+  std::map<std::string, std::string> meta_;  // worker -> serving metadata
   SaveLease save_lease_;
   int64_t next_task_id_ = 1;
   int pass_ = 0;

@@ -66,10 +66,16 @@ class HeartbeatThread:
     anything escaping that is counted, logged and survived — a missed
     beat only matters if ttl lapses, which is the coordinator's call."""
 
-    def __init__(self, endpoint, worker_id, ttl=10.0, steplog=None):
+    def __init__(self, endpoint, worker_id, ttl=10.0, steplog=None,
+                 meta=None):
         from paddle_tpu.distributed.client import CoordinatorClient
 
         self.ttl = float(ttl)
+        # optional flat metadata string (client.encode_host_meta)
+        # re-announced on every renewal: serving hosts publish their
+        # dial address through the lease itself, so address and
+        # liveness cannot disagree (serve/cluster.py)
+        self.meta = meta
         enforce(self.ttl > 0, "heartbeat ttl must be positive, got %r", ttl)
         # a renewal that cannot land within ttl is lost anyway — bound
         # the client's transport retries by it so shutdown never waits
@@ -89,7 +95,7 @@ class HeartbeatThread:
 
     def start(self):
         """Register the lease, then start renewing it."""
-        self._client.register(ttl=self.ttl)
+        self._client.register(ttl=self.ttl, meta=self.meta)
         with self._lock:
             self._last_ok = time.monotonic()
         self._thread.start()
@@ -126,7 +132,7 @@ class HeartbeatThread:
         interval = max(self.ttl / 3.0, 0.05)
         while not self._stop.wait(interval):
             try:
-                self._client.heartbeat(ttl=self.ttl)
+                self._client.heartbeat(ttl=self.ttl, meta=self.meta)
                 with self._lock:
                     self._beats += 1
                     self._last_ok = time.monotonic()

@@ -771,6 +771,29 @@ class StepLog:
             rec["detail"] = str(detail)
         self.write(rec)
 
+    def log_serve_host_event(self, kind, host=None, hosts=None,
+                             session=None, target=None, detail=None):
+        """One serving-host membership transition seen by the
+        fleet-of-fleets front (serve/cluster.py): ``join`` /
+        ``lease_lost`` / ``excluded`` / ``session_rehome`` /
+        ``rejoin`` — the serving twin of :meth:`log_elastic_event`.
+        ``hosts`` is the membership snapshot AT the event; a
+        ``session_rehome`` names the migrated session and its new
+        home in ``session`` / ``target``."""
+        rec = {"type": "serve_host_event", "kind": str(kind),
+               "t": round(time.perf_counter() - self._t0, 4)}
+        if host is not None:
+            rec["host"] = str(host)
+        if hosts is not None:
+            rec["hosts"] = [str(h) for h in hosts]
+        if session is not None:
+            rec["session"] = str(session)
+        if target is not None:
+            rec["target"] = str(target)
+        if detail is not None:
+            rec["detail"] = str(detail)
+        self.write(rec)
+
     def log_pass(self, pass_id, metrics=None):
         rec = {"type": "pass", "pass": int(pass_id),
                "t": round(time.perf_counter() - self._t0, 4)}
@@ -889,8 +912,10 @@ def summarize_dir(directory):
 
     runs = []
     fleet_traced = {}  # base run name -> {worker index: [serve_trace]}
+    host_traced = {}  # base run name -> {host id: [serve_trace]}
     train_workers = {}  # worker id -> pooled steady walls/steps/files
     elastic_events = []  # (meta unix_time, elastic_event record) pairs
+    host_events = []  # (meta unix_time, serve_host_event record) pairs
     for path in sorted(glob.glob(os.path.join(directory, "*.steps.jsonl"))):
         records = read_jsonl(path)
         steps = [r for r in records if r.get("type") == "step"]
@@ -1004,6 +1029,16 @@ def summarize_dir(directory):
             # needs the absolute base (observe/trainview.py)
             base_t = meta.get("unix_time") or 0.0
             elastic_events.extend((base_t, r) for r in elastic)
+        hostev = [r for r in records
+                  if r.get("type") == "serve_host_event"]
+        if hostev:
+            # serving-host membership timeline (serve/cluster.py): the
+            # PR 19 elastic-timeline treatment one level up — same
+            # absolute-axis stamping, since each front/host file's t is
+            # relative to its own meta line
+            run["serve_host_events"] = len(hostev)
+            base_t = meta.get("unix_time") or 0.0
+            host_events.extend((base_t, r) for r in hostev)
         controls = [r for r in records
                     if r.get("type") == "control_action"]
         if controls:
@@ -1042,6 +1077,21 @@ def summarize_dir(directory):
                 base = m.group(1)
             fleet_traced.setdefault(base, {})[
                 str(meta.get("worker"))] = traced
+        if meta.get("host") is not None:
+            run["serve_host"] = meta.get("host")
+        if meta.get("host") is not None and traced:
+            # per-HOST steplog of a multi-host serving cluster
+            # (<run>@<host>.steps.jsonl, cli serve --join): the
+            # per-worker merge pattern one level up — pool across
+            # hosts before attributing the cluster's true tail
+            import re
+
+            base = str(meta.get("run") or os.path.basename(path))
+            m = re.match(r"^(.*)@(.+)$", base)
+            if m:
+                base = m.group(1)
+            host_traced.setdefault(base, {})[
+                str(meta.get("host"))] = traced
         ex = [r["examples_per_sec"] for r in steps
               if "examples_per_sec" in r]
         if not ex:
@@ -1077,6 +1127,30 @@ def summarize_dir(directory):
                 w["p99_ms"] = round(percentile(lats, 99), 3)
             entry["workers"][widx] = w
         fleets.append(entry)
+    clusters = []
+    for base in sorted(host_traced):
+        # cluster-merged tail attribution: every HOST file's
+        # serve_trace records pooled before the p99 — the same
+        # reasoning as the worker merge above, one level up (each
+        # host's own p99 is blind to the cluster's true tail)
+        from paddle_tpu.observe.metrics import percentile
+        from paddle_tpu.observe.tracing import tail_attribution
+
+        by_host = host_traced[base]
+        merged = [r for recs in by_host.values() for r in recs]
+        tail = tail_attribution(merged)
+        if not tail:
+            continue
+        entry = {"run": base, "serve_traces": len(merged),
+                 "serve_tail": tail, "hosts": {}}
+        for hid in sorted(by_host):
+            recs = by_host[hid]
+            lats = [r["latency_ms"] for r in recs if "latency_ms" in r]
+            h = {"traces": len(recs)}
+            if lats:
+                h["p99_ms"] = round(percentile(lats, 99), 3)
+            entry["hosts"][hid] = h
+        clusters.append(entry)
     traces = sorted(
         os.path.basename(p)
         for pat in ("*.json", "*.json.gz")
@@ -1085,6 +1159,24 @@ def summarize_dir(directory):
     out = {"directory": directory, "runs": runs, "trace_files": traces}
     if fleets:
         out["fleets"] = fleets
+    if clusters:
+        out["serve_clusters"] = clusters
+    if host_events:
+        # the host membership timeline: join/lease_lost/excluded/
+        # session_rehome/rejoin across every front/host file, on one
+        # absolute axis (printed by `cli observe` next to the elastic
+        # timeline)
+        events = []
+        for base_t, r in host_events:
+            ev = {"t_abs": round(base_t + r.get("t", 0.0), 3),
+                  "kind": r.get("kind")}
+            for key in ("host", "hosts", "session", "target", "detail"):
+                if key in r:
+                    ev[key] = r[key]
+            events.append(ev)
+        events.sort(key=lambda e: e["t_abs"])
+        rehomes = sum(1 for e in events if e["kind"] == "session_rehome")
+        out["serve_hosts"] = {"events": events, "rehomes": rehomes}
     if train_workers or elastic_events:
         # the training-fleet block: per-worker step-time skew + the
         # straggler verdict + the merged elastic timeline

@@ -223,9 +223,15 @@ class ContinuousScheduler:
         self._spill_asap = set()    # sids with a forced spill requested
         self._closing = set()       # closed while their spill is in flight
         # the host-side page file: suspended carries + tombstones
-        self._store = session_store or SessionStore(
-            capacity=session_capacity, slo_grace_ms=session_slo_grace_ms,
-            ttl_ms=session_ttl_ms)
+        # identity check, NOT truthiness: stores define __len__, so an
+        # EMPTY injected store (the normal case at construction) is
+        # falsy and `or` would silently swap in a fresh local one —
+        # exactly wrong for a shared remote store (serve/remote_store)
+        self._store = (session_store if session_store is not None
+                       else SessionStore(
+                           capacity=session_capacity,
+                           slo_grace_ms=session_slo_grace_ms,
+                           ttl_ms=session_ttl_ms))
         # -- spill writer (guarded by self._swap_cv) -----------------------
         self._swap_cv = threading.Condition()
         self._swap_q = collections.deque()

@@ -69,7 +69,10 @@ class _BaseHandler(BaseHTTPRequestHandler):
                         headers=headers)
 
     def _send_text(self, code, text, content_type, headers=None):
-        body = text.encode()
+        self._send_bytes(code, text.encode(), content_type,
+                         headers=headers)
+
+    def _send_bytes(self, code, body, content_type, headers=None):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
@@ -178,12 +181,21 @@ class _BaseHandler(BaseHTTPRequestHandler):
 
 
 class _Handler(_BaseHandler):
-    """Single-model handler (the PR 3/4 contract, unchanged)."""
+    """Single-model handler (the PR 3/4 contract, plus the multi-host
+    admin surface: ``POST /admin/session/{spill,export,import}`` are
+    the durability/migration verbs the fleet-of-fleets front drives
+    (serve/cluster.py), and ``GET /debug/compiles`` exposes the
+    process-wide compile counter the hosts-ab bench gates on)."""
 
     engine = None
     bundle = None
     slo = None
     controller = None
+    compiles_fn = None
+
+    # binary session-state messages (the ShmRing frame codec over
+    # HTTP bodies — no pickling)
+    _FRAMES_TYPE = "application/x-paddle-frames"
 
     def do_GET(self):
         if self.path == "/healthz":
@@ -218,12 +230,77 @@ class _Handler(_BaseHandler):
                                           "server (serve --autotune)"})
             else:
                 self._send(200, self.controller.snapshot())
+        elif self.path == "/debug/compiles":
+            # process-wide compile count since serve started: the
+            # cluster front diffs this around chaos windows to assert
+            # a re-homed session re-used the survivor's warm caches
+            if self.compiles_fn is None:
+                self._send(404, {"error": "no compile watcher on this "
+                                          "server (serve --join)"})
+            else:
+                self._send(200, {"compiles": int(self.compiles_fn())})
         elif self.path == "/manifest":
             self._send(200, self.bundle.manifest)
         else:
             self._send(404, {"error": "unknown path %s" % self.path})
 
+    def _session_admin(self, verb):
+        """The migration/durability verbs. ``spill`` commits a parked
+        session's carry to the (possibly remote) store and returns
+        once it is durable — the front's commit point after every
+        acked chunk. ``export`` removes the state and ships it as
+        binary frames; ``import`` adopts frames shipped by a peer —
+        together the live-rebalance path (dead-host re-homes go
+        through the shared remote store instead)."""
+        engine = self.engine
+        if not hasattr(engine, "spill_session"):
+            raise ValueError(
+                "this engine has no session admin surface (serve "
+                "--continuous holds sessions; batch engines do not)")
+        from paddle_tpu.serve import workers as serve_workers
+
+        if verb == "import":
+            length = int(self.headers.get("Content-Length", "0"))
+            header, arrays = serve_workers.decode_buffer(
+                self.rfile.read(length))
+            sid = str(header["session_id"])
+            state = serve_workers.decode_state(sid, header["state"],
+                                               arrays)
+            engine.import_session(sid, state)
+            self._send(200, {"ok": True, "session_id": sid,
+                             "nbytes": int(state.nbytes)})
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        payload = json.loads(self.rfile.read(length) or b"{}")
+        sid = payload.get("session_id")
+        if sid is None:
+            raise ValueError('body must be {"session_id": ...}')
+        sid = str(sid)
+        if verb == "close":
+            engine.close_session(sid)  # idempotent, unknown ids no-op
+            self._send(200, {"ok": True, "session_id": sid})
+        elif verb == "spill":
+            engine.spill_session(sid,
+                                 timeout=float(payload.get("timeout_s",
+                                                           30.0)))
+            self._send(200, {"ok": True, "session_id": sid})
+        else:  # export
+            state = engine.export_session(
+                sid, timeout=float(payload.get("timeout_s", 30.0)))
+            shead, sarrays = serve_workers.encode_state(state)
+            frames, _total = serve_workers.encode_frames(
+                {"session_id": sid, "state": shead}, sarrays)
+            self._send_bytes(200, b"".join(bytes(f) for f in frames),
+                             self._FRAMES_TYPE)
+
     def do_POST(self):
+        if self.path.startswith("/admin/session/"):
+            verb = self.path[len("/admin/session/"):]
+            if verb not in ("spill", "export", "import", "close"):
+                self._send(404, {"error": "unknown path %s" % self.path})
+                return
+            self._infer_errors(lambda: self._session_admin(verb))
+            return
         if self.path != "/infer":
             self._send(404, {"error": "unknown path %s" % self.path})
             return
@@ -338,19 +415,21 @@ class _RouterHandler(_BaseHandler):
 
 
 def make_server(bundle, engine, host="127.0.0.1", port=0, slo=None,
-                controller=None):
+                controller=None, compiles_fn=None):
     """Single-model server bound to (host, port); ``port=0`` picks a
     free port (``server.server_address[1]`` is the actual one).
     ``slo=`` is an :class:`~paddle_tpu.observe.health.SloMonitor`; when
     omitted a no-objective monitor is built so ``GET /debug/slo``
     always answers (state ``no_objective``, burn rates zero).
     ``controller=`` (a :class:`~paddle_tpu.control.controller
-    .Controller`) enables ``GET /debug/control``."""
+    .Controller`) enables ``GET /debug/control``; ``compiles_fn=``
+    (a zero-arg callable, e.g. a ``CompileWatcher``'s count) enables
+    ``GET /debug/compiles``."""
     if slo is None:
         slo = observe_health.SloMonitor([engine])
     handler = type("BundleHandler", (_Handler,),
                    {"engine": engine, "bundle": bundle, "slo": slo,
-                    "controller": controller})
+                    "controller": controller, "compiles_fn": compiles_fn})
     return ThreadingHTTPServer((host, port), handler)
 
 
@@ -368,12 +447,13 @@ def make_router_server(router, host="127.0.0.1", port=0, slo=None,
 
 
 def serve_in_thread(bundle, engine, host="127.0.0.1", port=0, slo=None,
-                    controller=None):
+                    controller=None, compiles_fn=None):
     """Start a single-model server on a daemon thread; returns
     (server, thread) — tests and notebooks use this, the CLI uses
     serve_forever."""
     return _spawn(make_server(bundle, engine, host, port, slo=slo,
-                              controller=controller))
+                              controller=controller,
+                              compiles_fn=compiles_fn))
 
 
 def serve_router_in_thread(router, host="127.0.0.1", port=0, slo=None,

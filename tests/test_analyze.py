@@ -166,6 +166,27 @@ def test_pta003_catches_unnamed_worker_heartbeat_thread():
     assert lint.lint_source(named, "workers.py") == []
 
 
+def test_pta003_catches_unnamed_host_watch_thread():
+    """The cluster-front bug class (serve/cluster.py): a front starting
+    its coordinator membership watcher anonymously — the thread a hung
+    multi-host front's stack dump must be able to name."""
+    src = (
+        "import threading\n"
+        "class ClusterFront:\n"
+        "    def __init__(self):\n"
+        "        self._watch = threading.Thread(\n"
+        "            target=self._watch_loop, daemon=True)\n"
+        "        self._watch.start()\n"
+        "    def _watch_loop(self):\n"
+        "        pass\n"
+    )
+    findings = lint.lint_source(src, "cluster.py")
+    assert _ids(findings) == ["PTA003"]
+    named = src.replace("daemon=True",
+                        "daemon=True, name='serve-host-watch'")
+    assert lint.lint_source(named, "cluster.py") == []
+
+
 def test_pta004_unlocked_registry():
     src = (
         "import threading\n"
@@ -293,6 +314,40 @@ def test_pta005_helper_resolution_and_init_exempt():
                      "        self._apply()\n")
     findings = lint.lint_source(src_bad, "m.py")
     assert _ids(findings) == ["PTA005"]
+
+
+def test_pta005_membership_snapshot_idiom():
+    """The cluster-front membership idiom (serve/cluster.py): the host
+    table and ring are written under the front's lock by the watcher,
+    so a dispatch-side read outside the lock flags — and the fix is the
+    locked ``_snapshot()`` copy every reader goes through."""
+    src = (
+        "import threading\n"
+        "class Front:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._hosts = {}\n"
+        "        self._ring = None\n"
+        "    def _admit(self, host, entry):\n"
+        "        with self._lock:\n"
+        "            self._hosts[host] = entry\n"
+        "            self._ring = tuple(self._hosts)\n"
+        "    def dispatch(self, key):\n"
+        "        return self._ring\n"   # torn read: watcher mid-update
+    )
+    findings = lint.lint_source(src, "cluster.py")
+    assert _ids(findings) == ["PTA005"]
+    assert "'self._ring'" in findings[0].message
+    snapshotted = src.replace(
+        "    def dispatch(self, key):\n"
+        "        return self._ring\n",
+        "    def _snapshot(self):\n"
+        "        with self._lock:\n"
+        "            return dict(self._hosts), self._ring\n"
+        "    def dispatch(self, key):\n"
+        "        hosts, ring = self._snapshot()\n"
+        "        return ring\n")
+    assert lint.lint_source(snapshotted, "cluster.py") == []
 
 
 _PTA006_SRC = """

@@ -1,0 +1,442 @@
+"""Multi-host serving control plane tests (serve/cluster.py +
+serve/remote_store.py) — the ISSUE 20 acceptance surface:
+
+* **remote store**: the standalone store round-trips a session carry
+  bitwise over its length-prefixed frame codec (zero pickling), and
+  its eviction order matches the host-local ``SessionStore`` given the
+  same puts — same victims, same order, same tombstones.
+* **cluster-consistent 410**: an eviction tombstone written through
+  one host's scheduler answers ``SessionGone`` to a resume attempt on
+  a DIFFERENT host sharing the store — the fix for the process-local
+  tombstone hole.
+* **fleet-of-fleets front**: static-membership front routes session
+  chunks with ring affinity bitwise-equal to the whole-sequence
+  decode; killing the session's home host mid-conversation re-homes
+  it onto the survivor with zero committed chunks lost (the carries
+  live in the shared store, not on the dead host).
+* **lease liveness**: a host joined through the coordinator (TTL
+  heartbeat lease + dial address in the lease meta) is discovered by
+  the front; stopping its heartbeat excludes it after the lease
+  lapses — the serving twin of WorkerLost.
+
+Subprocess-heavy cases (two ``cli serve --join`` hosts, SIGKILL) are
+marked ``slow``; the tier-1 run keeps the in-thread front and the
+coordinator-backed join smoke.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+
+# -- bundle fixture ----------------------------------------------------------
+
+def _tagger_bundle(tmp):
+    from paddle_tpu.graph import reset_name_counters
+    from paddle_tpu.models.text import sequence_tagging_gru
+    from paddle_tpu.parameters import Parameters
+    from paddle_tpu.serve import load_bundle
+    from paddle_tpu.serve.export import export_bundle
+
+    reset_name_counters()
+    out = sequence_tagging_gru(dict_size=50, label_size=5, emb_size=8,
+                               hidden=12)
+    params = Parameters.create(out)
+    bundle_dir = str(tmp / "tagger_bundle")
+    export_bundle(out, params, bundle_dir, batch_sizes=(1,), seq_len=32,
+                  name="tagger", decode_slots=(2,), decode_window=4)
+    return load_bundle(bundle_dir)
+
+
+@pytest.fixture(scope="module")
+def decode_bundle(tmp_path_factory):
+    return _tagger_bundle(tmp_path_factory.mktemp("cluster_tagger"))
+
+
+def _seq(n, seed=0, vocab=50):
+    return (np.random.RandomState(seed)
+            .randint(0, vocab, size=(n,)).astype(np.int32))
+
+
+def _state(sid, priority="normal", pos=3, seed=0):
+    from paddle_tpu.serve.sessions import SessionState
+
+    rng = np.random.RandomState(seed)
+    carry = {"gru": [rng.randn(2, 12).astype(np.float32)],
+             "cell": [rng.randn(5).astype(np.float32)]}
+    return SessionState(sid, carry, pos=pos, priority=priority)
+
+
+# -- remote store ------------------------------------------------------------
+
+def test_remote_store_roundtrip_bitwise():
+    """put/pop through the socket store returns the carry bitwise (the
+    frame codec ships raw bytes, never pickles) with pos/priority
+    intact, and the duck-type surface (len/contains/stats/ping)
+    matches the local store's."""
+    from paddle_tpu.serve.remote_store import (RemoteSessionStore,
+                                               spawn_store_in_thread)
+
+    server = spawn_store_in_thread(capacity=8)
+    try:
+        remote = RemoteSessionStore(server.address)
+        want = _state("s", priority="high", pos=7, seed=3)
+        blob = {k: [a.tobytes() for a in v]
+                for k, v in want.carry.items()}
+        assert remote.put(want) == []
+        assert remote.ping()
+        assert len(remote) == 1 and "s" in remote
+        assert remote.stats()["suspended"] == 1
+        got = remote.pop("s")
+        assert got.pos == 7 and got.priority == "high"
+        assert sorted(got.carry) == sorted(blob)
+        for layer, leaves in blob.items():
+            assert [a.tobytes() for a in got.carry[layer]] == leaves
+            assert all(a.dtype == b.dtype for a, b in
+                       zip(got.carry[layer], want.carry[layer]))
+        assert "s" not in remote
+        with pytest.raises(KeyError):
+            remote.pop("never-held")
+        remote.close()
+    finally:
+        server.stop()
+
+
+def test_remote_store_eviction_parity_with_local():
+    """The same put sequence against a same-capacity local store
+    produces the same victims in the same order (priority rank, then
+    LRU) — the remote half reports them as stubs carrying the
+    accounting fields (id/nbytes/pos) the scheduler reads."""
+    from paddle_tpu.serve.remote_store import (RemoteSessionStore,
+                                               spawn_store_in_thread)
+    from paddle_tpu.serve.sessions import SessionGone, SessionStore
+
+    server = spawn_store_in_thread(capacity=2)
+    try:
+        remote = RemoteSessionStore(server.address)
+        local = SessionStore(capacity=2)
+        evicted_r, evicted_l = [], []
+        for i, (sid, prio) in enumerate(
+                [("low1", "low"), ("norm1", "normal"),
+                 ("high1", "high"), ("norm2", "normal")]):
+            evicted_r.extend(remote.put(_state(sid, prio, seed=i)))
+            evicted_l.extend(local.put(_state(sid, prio, seed=i)))
+        assert [e.session_id for e in evicted_l] == ["low1", "norm1"]
+        assert ([e.session_id for e in evicted_r]
+                == [e.session_id for e in evicted_l])
+        assert ([(e.nbytes, e.pos) for e in evicted_r]
+                == [(e.nbytes, e.pos) for e in evicted_l])
+        # tombstones agree too: both answer the 410 reason
+        for store in (remote, local):
+            assert store.gone_reason("low1") == "capacity"
+            with pytest.raises(SessionGone):
+                store.pop("low1")
+        remote.close()
+    finally:
+        server.stop()
+
+
+def test_cross_host_tombstone_cluster_consistent(decode_bundle):
+    """Regression (the process-local tombstone hole): a session evicted
+    through host A's scheduler must answer 410 SessionGone on host B —
+    both schedulers page against the SHARED store, so the tombstone
+    check routes through it instead of a per-process dict."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler, SessionGone
+    from paddle_tpu.serve.remote_store import (RemoteSessionStore,
+                                               spawn_store_in_thread)
+
+    server = spawn_store_in_thread(capacity=1)
+    try:
+        a = ContinuousScheduler(
+            decode_bundle, warmup=True,
+            metrics_registry=MetricsRegistry(),
+            session_store=RemoteSessionStore(server.address))
+        b = ContinuousScheduler(
+            decode_bundle, warmup=True,
+            metrics_registry=MetricsRegistry(),
+            session_store=RemoteSessionStore(server.address))
+        try:
+            a.submit({"word": _seq(4, seed=1)},
+                     session_id="a").result(timeout=120)
+            a.submit({"word": _seq(4, seed=2)},
+                     session_id="b").result(timeout=120)
+            a.spill_session("a")
+            a.spill_session("b")  # shared capacity 1: evicts a
+            with pytest.raises(SessionGone) as exc_info:
+                b.submit({"word": _seq(4, seed=3)}, session_id="a")
+            assert exc_info.value.session_id == "a"
+            assert exc_info.value.reason == "capacity"
+            # an id the cluster never saw still starts fresh on B
+            b.submit({"word": _seq(4, seed=4)},
+                     session_id="fresh").result(timeout=120)
+        finally:
+            a.stop()
+            b.stop()
+    finally:
+        server.stop()
+
+
+# -- fleet-of-fleets front ---------------------------------------------------
+
+def _spawn_host(bundle, store_addr):
+    """One in-thread serving host paging against the shared store;
+    returns (scheduler, http server, dial address)."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler
+    from paddle_tpu.serve import server as serve_server
+    from paddle_tpu.serve.remote_store import RemoteSessionStore
+
+    sched = ContinuousScheduler(
+        bundle, warmup=True, metrics_registry=MetricsRegistry(),
+        session_store=RemoteSessionStore(store_addr))
+    srv, _ = serve_server.serve_in_thread(bundle, sched)
+    return sched, srv, "127.0.0.1:%d" % srv.server_address[1]
+
+
+def _kill_host(sched, srv):
+    """The in-thread stand-in for SIGKILL: stop answering AND close the
+    listening socket so the next dial fails fast (connection refused),
+    exactly what a dead process looks like to the front."""
+    srv.shutdown()
+    srv.server_close()
+    sched.stop()
+
+
+def test_front_session_rehomes_bitwise_on_host_death(decode_bundle):
+    """Three session chunks through the front equal the whole-sequence
+    decode bitwise; the home host dies after chunk 2 (committed), the
+    session re-homes onto the survivor from the shared store, and the
+    concatenated outputs STILL equal the whole decode — zero committed
+    chunks lost."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler
+    from paddle_tpu.serve.cluster import ClusterFront
+    from paddle_tpu.serve.remote_store import spawn_store_in_thread
+
+    seq = _seq(12, seed=7)
+    ref = ContinuousScheduler(decode_bundle, warmup=True,
+                              metrics_registry=MetricsRegistry())
+    whole = ref.submit({"word": seq}).result(timeout=120)["gru_tag_out"]
+    ref.stop()
+
+    store = spawn_store_in_thread(capacity=16)
+    hosts = {}
+    try:
+        for hid in ("h0", "h1"):
+            hosts[hid] = _spawn_host(decode_bundle, store.address)
+        front = ClusterFront(
+            static_hosts={h: addr for h, (_, _, addr) in hosts.items()},
+            metrics_registry=MetricsRegistry(),
+            host_timeout=10.0, request_timeout=30.0)
+        try:
+            assert front.ready() and front.live()
+            assert sorted(front.ready_detail()) == ["h0", "h1"]
+            pieces = [front.infer({"word": seq[0:4]}, session_id="conv",
+                                  timeout=120.0)["gru_tag_out"],
+                      front.infer({"word": seq[4:8]}, session_id="conv",
+                                  timeout=120.0)["gru_tag_out"]]
+            home = front._session_last["conv"]
+            # committed after every acked chunk: the carry sits in the
+            # SHARED store during think-time, not on the home host
+            assert len(store.store) == 1
+            _kill_host(*hosts.pop(home)[:2])
+            pieces.append(front.infer({"word": seq[8:12]},
+                                      session_id="conv",
+                                      timeout=120.0)["gru_tag_out"])
+            assert front._session_last["conv"] != home
+            assert np.array_equal(np.concatenate(pieces), whole), \
+                "re-homed session must continue bitwise"
+            stats = front.stats()
+            assert stats["session_rehomes"] == 1
+            assert stats["hosts_excluded"] == 1
+            assert stats["hosts_live"] == 1
+        finally:
+            front.stop()
+    finally:
+        for sched, srv, _ in hosts.values():
+            _kill_host(sched, srv)
+        store.stop()
+
+
+def test_front_sheds_no_host():
+    """An empty (or all-dead) ring sheds with reason ``no_host`` —
+    counted, health-recorded, surfaced as Overloaded/429."""
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import Overloaded
+    from paddle_tpu.serve.cluster import ClusterFront
+
+    front = ClusterFront(static_hosts={},
+                         metrics_registry=MetricsRegistry())
+    try:
+        assert not front.ready()
+        with pytest.raises(Overloaded) as exc_info:
+            front.infer({"word": _seq(4)})
+        assert exc_info.value.reason == "no_host"
+        assert front.stats()["shed_no_host"] == 1
+    finally:
+        front.stop()
+
+
+def test_front_join_and_lease_lapse(decode_bundle):
+    """The coordinator-backed membership loop: a host publishing its
+    dial address through the lease meta is discovered and routed to;
+    stopping its heartbeat excludes it once the lease lapses (the
+    serving twin of WorkerLost), and the front sheds ``no_host``."""
+    from paddle_tpu.distributed.client import (
+        encode_host_meta, spawn_coordinator_on_free_port)
+    from paddle_tpu.distributed.elastic import HeartbeatThread
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import Overloaded
+    from paddle_tpu.serve.cluster import ClusterFront
+    from paddle_tpu.serve.remote_store import spawn_store_in_thread
+
+    port, coord = spawn_coordinator_on_free_port()
+    endpoint = "127.0.0.1:%d" % port
+    store = spawn_store_in_thread(capacity=8)
+    sched = srv = hb = front = None
+    try:
+        sched, srv, addr = _spawn_host(decode_bundle, store.address)
+        hb = HeartbeatThread(endpoint, worker_id="solo", ttl=1.5,
+                             meta=encode_host_meta(kind="serve",
+                                                   addr=addr))
+        hb.start()
+        front = ClusterFront(endpoint=endpoint, poll_interval=0.2,
+                             metrics_registry=MetricsRegistry(),
+                             host_timeout=10.0, request_timeout=30.0)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not front.hosts():
+            time.sleep(0.1)
+        hosts = front.hosts()
+        assert list(hosts) == ["solo"]
+        assert hosts["solo"]["address"] == addr
+        assert hosts["solo"]["live"]
+        out = front.infer({"word": _seq(6, seed=2)}, session_id="s1",
+                          timeout=120.0)
+        assert out["gru_tag_out"].shape[0] == 6
+        hb.stop()  # silent host: the lease must lapse, not linger
+        deadline = time.monotonic() + 30.0
+        while (time.monotonic() < deadline
+               and front.stats()["hosts_live"]):
+            time.sleep(0.2)
+        assert front.stats()["hosts_live"] == 0
+        with pytest.raises(Overloaded):
+            front.infer({"word": _seq(2, seed=3)})
+    finally:
+        if front is not None:
+            front.stop()
+        if hb is not None:
+            hb.stop()
+        if srv is not None:
+            _kill_host(sched, srv)
+        store.stop()
+        coord.terminate()
+        coord.wait(timeout=10)
+
+
+# -- slow suite: two cli hosts, SIGKILL --------------------------------------
+
+def _spawn_cli_host(bundle_dir, host_id, endpoint, store_addr):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1",
+               PYTHONPATH="/root/repo")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.cli", "serve", bundle_dir,
+         "--continuous", "--port", "0", "--join", endpoint,
+         "--host-id", host_id, "--lease-ttl", "5",
+         "--session-store-addr", store_addr],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+    return proc
+
+
+@pytest.mark.slow
+def test_two_cli_hosts_sigkill_zero_committed_loss(decode_bundle):
+    """The hosts-ab drill as a test: two ``cli serve --join`` OS
+    processes behind the coordinator and one shared store process;
+    SIGKILL the session's home mid-conversation (between committed
+    chunks) — the front re-homes it onto the survivor and the full
+    conversation stays bitwise-equal to the whole-sequence decode."""
+    from paddle_tpu.distributed.client import CoordinatorClient
+    from paddle_tpu.distributed.client import (
+        spawn_coordinator_on_free_port)
+    from paddle_tpu.observe.metrics import MetricsRegistry
+    from paddle_tpu.serve import ContinuousScheduler
+    from paddle_tpu.serve.cluster import ClusterFront
+
+    seq = _seq(12, seed=11)
+    ref = ContinuousScheduler(decode_bundle, warmup=True,
+                              metrics_registry=MetricsRegistry())
+    whole = ref.submit({"word": seq}).result(timeout=120)["gru_tag_out"]
+    ref.stop()
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    port, coord = spawn_coordinator_on_free_port()
+    endpoint = "127.0.0.1:%d" % port
+    store = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serve.remote_store",
+         "--port", "0", "--capacity", "64"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    procs, front = {}, None
+    try:
+        line = store.stdout.readline().strip()
+        assert line.startswith("listening "), line
+        store_addr = line.split()[-1]
+        for hid in ("h0", "h1"):
+            procs[hid] = _spawn_cli_host(decode_bundle.directory, hid,
+                                         endpoint, store_addr)
+        client = CoordinatorClient(endpoint, worker_id="test",
+                                   retry_timeout=5.0)
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            if len(client.serve_hosts()["hosts"]) == 2:
+                break
+            for hid, p in procs.items():
+                assert p.poll() is None, \
+                    "host %s died early" % hid
+            time.sleep(0.5)
+        else:
+            pytest.fail("hosts never joined the coordinator")
+        client.close()
+        front = ClusterFront(endpoint=endpoint, poll_interval=0.2,
+                             metrics_registry=MetricsRegistry(),
+                             host_timeout=10.0, request_timeout=60.0)
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline and not front.ready():
+            time.sleep(0.5)
+        assert front.ready(), "hosts never warmed"
+
+        pieces = [front.infer({"word": seq[0:4]}, session_id="conv",
+                              timeout=120.0)["gru_tag_out"],
+                  front.infer({"word": seq[4:8]}, session_id="conv",
+                              timeout=120.0)["gru_tag_out"]]
+        home = front._session_last["conv"]
+        os.kill(procs[home].pid, signal.SIGKILL)
+        procs[home].wait(timeout=30)
+        pieces.append(front.infer({"word": seq[8:12]},
+                                  session_id="conv",
+                                  timeout=120.0)["gru_tag_out"])
+        assert front._session_last["conv"] != home
+        assert np.array_equal(np.concatenate(pieces), whole), \
+            "SIGKILL of the home must lose zero committed chunks"
+        assert front.stats()["session_rehomes"] == 1
+    finally:
+        if front is not None:
+            front.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        store.terminate()
+        store.wait(timeout=10)
+        coord.terminate()
+        coord.wait(timeout=10)

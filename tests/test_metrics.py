@@ -155,6 +155,17 @@ def _golden_registry(include_workers=True):
                        buckets=(0.5, 2.0, 10.0))
     for v in (0.2, 1.1, 6.0):
         sw.observe(v)
+    # multi-host serving families (serve/cluster.py): per-host ring
+    # membership plus the rehome counter — one excluded host mid-drill
+    for host, live in (("hostA", 1), ("hostB", 0)):
+        reg.gauge("paddle_tpu_serve_hosts",
+                  help="serving-host membership (1 live in the ring, "
+                       "0 excluded)",
+                  labels={"host": host}).set(live)
+    reg.counter("paddle_tpu_serve_host_rehomes_total",
+                help="sessions re-homed onto this host after their "
+                     "previous host left the ring",
+                labels={"host": "hostA"}).inc(3)
     # the SLO verdict gauges (observe/health.py SloMonitor publishes
     # into these every evaluation) — fixed mid-burn values
     slo = metrics.slo_gauges(reg)

@@ -455,6 +455,10 @@ def _full_featured_log(tmp_path):
         slog.log_elastic_event("checkpoint_commit", worker="trainer-0",
                                step=2,
                                checkpoint="pass-00000-step-00000002")
+        slog.log_serve_host_event("join", host="hostA",
+                                  hosts=["hostA"], detail="lease 2.0s")
+        slog.log_serve_host_event("session_rehome", host="hostB",
+                                  session="u1", target="hostA")
         slog.log_pass(0, metrics={"err": 0.25})
     return steplog.read_jsonl(os.path.join(str(tmp_path),
                                            "unit.steps.jsonl"))
